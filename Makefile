@@ -1,0 +1,10 @@
+proto:
+	protoc --python_out=elasticdl_tpu/proto -I elasticdl_tpu/proto elasticdl_tpu/proto/elasticdl_tpu.proto
+
+test:
+	python -m pytest tests/ -x -q
+
+native:
+	$(MAKE) -C elasticdl_tpu/native
+
+.PHONY: proto test native

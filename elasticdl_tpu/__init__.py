@@ -1,0 +1,11 @@
+"""elasticdl_tpu: a TPU-native elastic distributed training framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of ElasticDL (reference at
+/root/reference): a master control plane that dynamically shards data into
+tasks and elastically manages workers, a synchronous AllReduce data-parallel
+path expressed as shard_map + XLA collectives over ICI/DCN, and a
+parameter-server path with host-resident dense/sparse state and native C++
+optimizer kernels.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,39 @@
+"""Framework-wide constants (reference:
+/root/reference/elasticdl/python/common/constants.py,
+elasticdl_client/common/constants.py:15)."""
+
+
+class DistributionStrategy:
+    LOCAL = "Local"
+    PARAMETER_SERVER = "ParameterServerStrategy"
+    ALLREDUCE = "AllreduceStrategy"
+
+
+class JobType:
+    TRAINING_ONLY = "training_only"
+    EVALUATION_ONLY = "evaluation_only"
+    PREDICTION_ONLY = "prediction_only"
+    TRAINING_WITH_EVALUATION = "training_with_evaluation"
+
+
+class PodStatus:
+    INITIAL = "Initial"
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    DELETED = "Deleted"
+
+
+# Per-minibatch retry cap (reference worker/worker.py:37).
+DEFAULT_MAX_MINIBATCH_RETRY_NUM = 64
+
+# Per-task retry cap in the dispatcher (reference master/task_dispatcher.py).
+MAX_TASK_RETRIES = 3
+
+# Membership re-check cadence in AllReduce training, in steps
+# (reference worker/allreduce_trainer.py:141-148).
+COMM_WORLD_CHECK_STEPS = 20
+
+# Allreduce communication retry cap (reference allreduce_trainer.py:125-139).
+MAX_ALLREDUCE_RETRY_NUM = 5

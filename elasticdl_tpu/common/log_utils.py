@@ -1,0 +1,29 @@
+"""Logger factory with per-module levels (reference:
+/root/reference/elasticdl/python/common/log_utils.py:33)."""
+
+import logging
+import sys
+
+_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
+_configured = False
+
+
+def _configure_root():
+    global _configured
+    if _configured:
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    root = logging.getLogger("elasticdl_tpu")
+    root.addHandler(handler)
+    root.propagate = False
+    root.setLevel(logging.INFO)
+    _configured = True
+
+
+def get_logger(name: str, level=None) -> logging.Logger:
+    _configure_root()
+    logger = logging.getLogger(f"elasticdl_tpu.{name}")
+    if level is not None:
+        logger.setLevel(level)
+    return logger

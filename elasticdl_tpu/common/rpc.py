@@ -1,0 +1,108 @@
+"""Spec-driven gRPC stubs and servicer registration.
+
+The image has protoc but no grpc python plugin, so instead of codegen'd
+`*_pb2_grpc.py` files each service is declared once as a ServiceSpec table and
+both the client stub and the server handler are built from it generically.
+Method set mirrors the reference's Master and Pserver services
+(/root/reference/elasticdl/proto/elasticdl.proto:108-157).
+"""
+
+import concurrent.futures
+import dataclasses
+
+import grpc
+
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+# Matches the reference's 256 MB gRPC message cap
+# (/root/reference/elasticdl/python/common/constants.py:15-19).
+MAX_MESSAGE_LENGTH = 256 * 1024 * 1024
+
+GRPC_CHANNEL_OPTIONS = [
+    ("grpc.max_send_message_length", MAX_MESSAGE_LENGTH),
+    ("grpc.max_receive_message_length", MAX_MESSAGE_LENGTH),
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class ServiceSpec:
+    name: str
+    # method name -> (request class, response class)
+    methods: dict
+
+
+MASTER_SERVICE = ServiceSpec(
+    name="elasticdl_tpu.Master",
+    methods={
+        "get_task": (pb.GetTaskRequest, pb.Task),
+        "report_task_result": (pb.ReportTaskResultRequest, pb.Empty),
+        "report_evaluation_metrics": (pb.ReportEvaluationMetricsRequest, pb.Empty),
+        "report_version": (pb.ReportVersionRequest, pb.Empty),
+        "get_comm_rank": (pb.GetCommRankRequest, pb.GetCommRankResponse),
+        "report_worker_liveness": (pb.ReportWorkerLivenessRequest, pb.Empty),
+    },
+)
+
+PSERVER_SERVICE = ServiceSpec(
+    name="elasticdl_tpu.Pserver",
+    methods={
+        "push_model": (pb.Model, pb.Empty),
+        "push_embedding_table_infos": (pb.Model, pb.Empty),
+        "pull_dense_parameters": (
+            pb.PullDenseParametersRequest,
+            pb.PullDenseParametersResponse,
+        ),
+        "pull_embedding_vectors": (pb.PullEmbeddingVectorsRequest, pb.Tensor),
+        "push_gradients": (pb.PushGradientsRequest, pb.PushGradientsResponse),
+    },
+)
+
+
+class Stub:
+    """Client stub: one callable attribute per spec method."""
+
+    def __init__(self, channel: grpc.Channel, spec: ServiceSpec):
+        for method, (req_cls, resp_cls) in spec.methods.items():
+            setattr(
+                self,
+                method,
+                channel.unary_unary(
+                    f"/{spec.name}/{method}",
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=resp_cls.FromString,
+                ),
+            )
+
+
+def add_servicer_to_server(servicer, spec: ServiceSpec, server: grpc.Server):
+    """Register servicer methods (matched by name) for the spec's service."""
+    handlers = {}
+    for method, (req_cls, resp_cls) in spec.methods.items():
+        handlers[method] = grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, method),
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(spec.name, handlers),)
+    )
+
+
+def build_server(max_workers: int = 64) -> grpc.Server:
+    return grpc.server(
+        concurrent.futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=GRPC_CHANNEL_OPTIONS,
+    )
+
+
+def serve(servicer, spec: ServiceSpec, port: int = 0, max_workers: int = 64):
+    """Start a server for one servicer; returns (server, bound_port)."""
+    server = build_server(max_workers)
+    add_servicer_to_server(servicer, spec, server)
+    bound = server.add_insecure_port(f"[::]:{port}")
+    server.start()
+    return server, bound
+
+
+def build_channel(addr: str) -> grpc.Channel:
+    return grpc.insecure_channel(addr, options=GRPC_CHANNEL_OPTIONS)

@@ -1,0 +1,3 @@
+from elasticdl_tpu.proto import elasticdl_tpu_pb2
+
+__all__ = ["elasticdl_tpu_pb2"]

@@ -1,0 +1,29 @@
+import numpy as np
+
+from elasticdl_tpu.common import hash_utils
+
+
+def test_string_to_id_stable_and_bounded():
+    for n in [1, 2, 7]:
+        ids = {name: hash_utils.string_to_id(name, n) for name in
+               ["dense/kernel", "dense/bias", "conv/kernel"]}
+        for v in ids.values():
+            assert 0 <= v < n
+        # Stability: same inputs always map identically.
+        assert ids == {k: hash_utils.string_to_id(k, n) for k in ids}
+
+
+def test_scatter_embedding_ids():
+    ids = np.array([0, 1, 2, 3, 4, 5, 6], dtype=np.int64)
+    parts = hash_utils.scatter_embedding_ids(ids, 3)
+    seen = np.zeros(len(ids), dtype=bool)
+    for ps_id, (sub_ids, positions) in parts.items():
+        assert (sub_ids % 3 == ps_id).all()
+        np.testing.assert_array_equal(ids[positions], sub_ids)
+        seen[positions] = True
+    assert seen.all()
+
+
+def test_scatter_skips_empty_shards():
+    parts = hash_utils.scatter_embedding_ids(np.array([3, 6]), 3)
+    assert set(parts) == {0}

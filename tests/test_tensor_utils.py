@@ -1,0 +1,72 @@
+import numpy as np
+import pytest
+from ml_dtypes import bfloat16
+
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+
+@pytest.mark.parametrize(
+    "dtype",
+    [np.float32, np.float64, np.float16, bfloat16, np.int32, np.int64,
+     np.uint8, np.bool_],
+)
+def test_tensor_roundtrip(dtype):
+    rng = np.random.default_rng(0)
+    arr = rng.standard_normal((3, 4, 5)).astype(dtype)
+    t = tensor_utils.ndarray_to_tensor_pb(arr, name="w")
+    assert t.name == "w"
+    back = tensor_utils.tensor_pb_to_ndarray(t)
+    assert back.dtype == arr.dtype
+    assert back.shape == arr.shape
+    np.testing.assert_array_equal(back, arr)
+
+
+def test_tensor_roundtrip_through_wire_bytes():
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    data = tensor_utils.ndarray_to_tensor_pb(arr).SerializeToString()
+    t = pb.Tensor()
+    t.ParseFromString(data)
+    np.testing.assert_array_equal(tensor_utils.tensor_pb_to_ndarray(t), arr)
+
+
+def test_scalar_and_empty():
+    for arr in [np.float32(3.5).reshape(()), np.zeros((0, 4), np.float32)]:
+        back = tensor_utils.tensor_pb_to_ndarray(
+            tensor_utils.ndarray_to_tensor_pb(arr)
+        )
+        assert back.shape == arr.shape
+
+
+def test_indexed_slices_roundtrip():
+    values = np.arange(8, dtype=np.float32).reshape(4, 2)
+    ids = np.array([3, 1, 4, 1])
+    s = tensor_utils.ndarray_to_indexed_slices_pb(values, ids, name="emb")
+    v2, i2 = tensor_utils.indexed_slices_pb_to_ndarrays(s)
+    np.testing.assert_array_equal(v2, values)
+    np.testing.assert_array_equal(i2, ids)
+
+
+def test_indexed_slices_shape_check():
+    with pytest.raises(ValueError):
+        tensor_utils.ndarray_to_indexed_slices_pb(
+            np.zeros((3, 2), np.float32), np.array([1, 2])
+        )
+
+
+def test_deduplicate_indexed_slices():
+    values = np.array([[1.0], [2.0], [10.0]], dtype=np.float32)
+    ids = np.array([7, 3, 7])
+    summed, unique = tensor_utils.deduplicate_indexed_slices(values, ids)
+    np.testing.assert_array_equal(unique, [3, 7])
+    np.testing.assert_allclose(summed, [[2.0], [11.0]])
+
+
+def test_merge_indexed_slices():
+    v1 = np.ones((2, 3), np.float32)
+    v2 = 2 * np.ones((1, 3), np.float32)
+    summed, unique = tensor_utils.merge_indexed_slices(
+        [v1, v2], [np.array([0, 5]), np.array([5])]
+    )
+    np.testing.assert_array_equal(unique, [0, 5])
+    np.testing.assert_allclose(summed[1], 3 * np.ones(3))
