@@ -5,6 +5,6 @@ test:
 	python -m pytest tests/ -x -q
 
 native:
-	$(MAKE) -C elasticdl_tpu/native
+	@if [ -f elasticdl_tpu/native/Makefile ]; then $(MAKE) -C elasticdl_tpu/native; else echo "native kernels not present yet"; fi
 
 .PHONY: proto test native

@@ -100,6 +100,8 @@ def serve(servicer, spec: ServiceSpec, port: int = 0, max_workers: int = 64):
     server = build_server(max_workers)
     add_servicer_to_server(servicer, spec, server)
     bound = server.add_insecure_port(f"[::]:{port}")
+    if bound == 0:
+        raise RuntimeError(f"failed to bind gRPC server to port {port}")
     server.start()
     return server, bound
 

@@ -68,7 +68,7 @@ def ndarray_to_indexed_slices_pb(
         )
     return pb.IndexedSlices(
         concat_tensors=ndarray_to_tensor_pb(values, name),
-        ids=[int(i) for i in ids],
+        ids=np.asarray(ids, dtype=np.int64).tolist(),
     )
 
 
