@@ -1,0 +1,88 @@
+"""Elastic membership epochs for the AllReduce path.
+
+TPU-native replacement for the reference's Horovod rendezvous server
+(/root/reference/elasticdl/python/master/rendezvous_server.py:31-110): the
+master tracks the set of alive worker hosts; any change bumps `group_id`
+(the rendezvous_id analog). Workers poll `get_comm_rank` between steps — a
+changed group_id tells them to re-initialize the JAX distributed runtime
+(jax.distributed) over the new host set and recompile their sharded step for
+the new mesh, with the rank-0 worker broadcasting parameters. Ranks are
+positions in the time-sorted host list, so they are stable for survivors.
+"""
+
+import threading
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("master.membership")
+
+
+class MembershipManager:
+    def __init__(self, coordinator_port=51000):
+        self._lock = threading.Lock()
+        self._hosts = []  # sorted by join order (pod start time analog)
+        self._group_id = 0
+        self._coordinator_port = coordinator_port
+
+    def set_worker_hosts(self, hosts):
+        """Replace the alive-host set (called by the instance manager on pod
+        events, reference k8s_instance_manager.py:387-389). Bumps the group
+        epoch iff membership changed."""
+        with self._lock:
+            if list(hosts) != self._hosts:
+                self._hosts = list(hosts)
+                self._group_id += 1
+                logger.info(
+                    "Membership epoch %d: %d workers",
+                    self._group_id,
+                    len(self._hosts),
+                )
+            return self._group_id
+
+    def add_worker_host(self, host):
+        with self._lock:
+            if host not in self._hosts:
+                self._hosts = self._hosts + [host]
+                self._group_id += 1
+                logger.info(
+                    "Worker %s joined; membership epoch %d (%d workers)",
+                    host,
+                    self._group_id,
+                    len(self._hosts),
+                )
+            return self._group_id
+
+    def remove_worker_host(self, host):
+        with self._lock:
+            if host in self._hosts:
+                self._hosts = [h for h in self._hosts if h != host]
+                self._group_id += 1
+                logger.info(
+                    "Worker %s left; membership epoch %d (%d workers)",
+                    host,
+                    self._group_id,
+                    len(self._hosts),
+                )
+            return self._group_id
+
+    def get_comm_rank(self, host):
+        """(rank, world_size, group_id, coordinator_addr). rank -1 means the
+        host is not (yet) in the group — it should keep polling."""
+        with self._lock:
+            rank = self._hosts.index(host) if host in self._hosts else -1
+            coordinator = (
+                f"{self._hosts[0]}:{self._coordinator_port}"
+                if self._hosts
+                else ""
+            )
+            return rank, len(self._hosts), self._group_id, coordinator
+
+    @property
+    def group_id(self):
+        with self._lock:
+            return self._group_id
+
+    @property
+    def worker_hosts(self):
+        with self._lock:
+            return list(self._hosts)

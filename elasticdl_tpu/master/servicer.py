@@ -1,0 +1,106 @@
+"""Master gRPC service.
+
+Serves the worker-facing task protocol (reference
+/root/reference/elasticdl/python/master/servicer.py:25-159): task pulls (with
+WAIT when the queue is momentarily empty but the job is unfinished), task
+results, evaluation metric reports, PS version reports (the evaluation
+trigger), comm-rank queries for elastic AllReduce, and worker liveness.
+"""
+
+import threading
+import time
+
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+logger = get_logger("master.servicer")
+
+
+class MasterServicer:
+    def __init__(
+        self,
+        task_dispatcher,
+        evaluation_service=None,
+        membership=None,
+    ):
+        self._task_d = task_dispatcher
+        self._evaluation_service = evaluation_service
+        self._membership = membership
+        self._lock = threading.Lock()
+        # worker_id -> last-RPC wall time, for the liveness watchdog
+        # (reference servicer.py:93-94).
+        self.worker_liveness = {}
+        self.max_model_version = 0
+
+    def _touch(self, worker_id):
+        with self._lock:
+            self.worker_liveness[worker_id] = time.time()
+
+    # ---------- rpc methods (names match rpc.MASTER_SERVICE) ----------
+
+    def get_task(self, request, context):
+        self._touch(request.worker_id)
+        if request.task_type == pb.EVALUATION:
+            task_id, task = self._task_d.get_eval_task(request.worker_id)
+        else:
+            task_id, task = self._task_d.get(request.worker_id)
+        if task is None:
+            # Queue momentarily empty: tell the worker to WAIT unless the
+            # whole job is done (then task_id stays -1 with default type).
+            res = pb.Task(task_id=-1)
+            if not self._task_d.finished():
+                res.type = pb.WAIT
+            return res
+        return task.to_proto(task_id)
+
+    def report_task_result(self, request, context):
+        success = not request.err_message
+        self._task_d.report(request.task_id, success, request.err_message)
+        return pb.Empty()
+
+    def report_evaluation_metrics(self, request, context):
+        self._touch(request.worker_id)
+        if self._evaluation_service is not None and request.model_outputs:
+            decoded = [
+                tensor_utils.tensor_pb_to_ndarray(t)
+                for t in request.model_outputs
+            ]
+            # Single-output models report one tensor; multi-output models
+            # report a list and their metrics receive the list.
+            outputs = decoded[0] if len(decoded) == 1 else decoded
+            labels = tensor_utils.tensor_pb_to_ndarray(request.labels)
+            self._evaluation_service.report_evaluation_metrics(
+                outputs, labels
+            )
+        return pb.Empty()
+
+    def report_version(self, request, context):
+        with self._lock:
+            self.max_model_version = max(
+                self.max_model_version, request.model_version
+            )
+        if self._evaluation_service is not None:
+            self._evaluation_service.add_evaluation_task_if_needed(
+                request.model_version
+            )
+        return pb.Empty()
+
+    def get_comm_rank(self, request, context):
+        if self._membership is None:
+            return pb.GetCommRankResponse(rank_id=-1)
+        rank, world, group_id, coordinator = self._membership.get_comm_rank(
+            request.worker_host
+        )
+        return pb.GetCommRankResponse(
+            rank_id=rank,
+            world_size=world,
+            rendezvous_id=group_id,
+            coordinator_addr=coordinator,
+        )
+
+    def report_worker_liveness(self, request, context):
+        self._touch(request.worker_id)
+        if self._membership is not None and request.host:
+            self._membership.add_worker_host(request.host)
+        return pb.Empty()

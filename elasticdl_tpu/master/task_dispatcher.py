@@ -1,0 +1,291 @@
+"""Dynamic data sharding: the master's task state machine.
+
+Re-implementation of the reference dispatcher's behavior
+(/root/reference/elasticdl/python/master/task_dispatcher.py:77-392): the
+dataset is partitioned into record-range tasks; workers pull tasks and report
+completion; failed tasks are re-queued up to MAX_TASK_RETRIES; a dead worker's
+in-flight tasks are recovered; training tasks regenerate per epoch. This is
+what makes training elastic without checkpoint-restart — task assignment is
+the only distributed state, and it lives here.
+
+The state machine is framework-agnostic by design (no JAX/TF imports).
+"""
+
+import collections
+import random
+import threading
+import time
+
+from elasticdl_tpu.common.constants import MAX_TASK_RETRIES
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+logger = get_logger("master.task_dispatcher")
+
+
+class _Task:
+    """A record range [start, end) in a named shard, plus retry accounting."""
+
+    def __init__(self, shard_name, start, end, task_type, model_version=-1):
+        self.shard_name = shard_name
+        self.start = start
+        self.end = end
+        self.type = task_type
+        self.model_version = model_version
+        self.retry_count = 0
+
+    def to_proto(self, task_id):
+        return pb.Task(
+            task_id=task_id,
+            shard_name=self.shard_name,
+            start=self.start,
+            end=self.end,
+            type=self.type,
+            model_version=self.model_version,
+        )
+
+    def __repr__(self):
+        return (
+            f"_Task({self.shard_name}[{self.start}:{self.end}] "
+            f"type={self.type} v={self.model_version})"
+        )
+
+
+class TaskDispatcher:
+    """Thread-safe todo/doing task queues with elastic recovery."""
+
+    def __init__(
+        self,
+        training_shards,
+        evaluation_shards=None,
+        prediction_shards=None,
+        records_per_task=1024,
+        num_epochs=1,
+        shuffle=True,
+        max_task_retries=MAX_TASK_RETRIES,
+        seed=None,
+    ):
+        """Shard dicts map shard_name -> (start_index, num_records)."""
+        self._lock = threading.Lock()
+        self._training_shards = dict(training_shards or {})
+        self._evaluation_shards = dict(evaluation_shards or {})
+        self._prediction_shards = dict(prediction_shards or {})
+        self._records_per_task = records_per_task
+        self._num_epochs = num_epochs
+        self._shuffle = shuffle
+        self._max_task_retries = max_task_retries
+        self._rng = random.Random(seed)
+
+        self._epoch = 0
+        self._next_task_id = 0
+        self._todo = []  # list of _Task, consumed from the front
+        self._doing = {}  # task_id -> (worker_id, _Task, start_time)
+        self._job_failed = False
+        self._stop_training = False
+        # Rolling completion-time stats per task type, for the timeout
+        # watchdog (reference master/servicer.py:131-148).
+        self._task_durations = {}  # task_type -> deque of seconds (bounded)
+        self._eval_complete_callbacks = []
+        self._tasks_done_callbacks = []
+
+        if self._training_shards:
+            logger.info("Starting epoch 0")
+            self._epoch = 1
+            self._create_tasks_locked(pb.TRAINING)
+        elif self._evaluation_shards:
+            self._create_tasks_locked(pb.EVALUATION)
+        elif self._prediction_shards:
+            self._create_tasks_locked(pb.PREDICTION)
+
+    # ---------- task creation ----------
+
+    def _shards_for(self, task_type):
+        return {
+            pb.TRAINING: self._training_shards,
+            pb.EVALUATION: self._evaluation_shards,
+            pb.PREDICTION: self._prediction_shards,
+        }[task_type]
+
+    def _create_tasks_locked(self, task_type, model_version=-1, at_front=False):
+        tasks = []
+        for name, (start, num_records) in self._shards_for(task_type).items():
+            for begin in range(start, start + num_records, self._records_per_task):
+                end = min(begin + self._records_per_task, start + num_records)
+                tasks.append(_Task(name, begin, end, task_type, model_version))
+        if task_type == pb.TRAINING and self._shuffle:
+            self._rng.shuffle(tasks)
+        if at_front:
+            self._todo = tasks + self._todo
+        else:
+            self._todo.extend(tasks)
+        return len(tasks)
+
+    def create_evaluation_tasks(self, model_version):
+        """Version-triggered eval: tasks go to the FRONT of the queue so
+        training workers pick them up promptly."""
+        with self._lock:
+            n = self._create_tasks_locked(
+                pb.EVALUATION, model_version, at_front=True
+            )
+        logger.info(
+            "Created %d evaluation tasks at model version %d", n, model_version
+        )
+        return n
+
+    def create_train_end_callback_task(self):
+        """One final task (e.g. model export) dispatched after training ends
+        (reference task_dispatcher.py: train-end callback support)."""
+        with self._lock:
+            if not self._training_shards:
+                return 0
+            name = next(iter(self._training_shards))
+            self._todo.append(_Task(name, 0, 0, pb.TRAIN_END_CALLBACK))
+            return 1
+
+    # ---------- worker-facing operations ----------
+
+    def get(self, worker_id):
+        """Pop the next task for a worker; () epoch rollover when the
+        training queue drains. Returns (task_id, _Task) or (-1, None)."""
+        with self._lock:
+            if not self._todo and not self._stop_training and (
+                self._epoch < self._num_epochs and self._training_shards
+            ):
+                logger.info("Starting epoch %d", self._epoch)
+                self._epoch += 1
+                self._create_tasks_locked(pb.TRAINING)
+            if not self._todo:
+                return -1, None
+            task = self._todo.pop(0)
+            task_id = self._next_task_id
+            self._next_task_id += 1
+            self._doing[task_id] = (worker_id, task, time.time())
+            return task_id, task
+
+    def get_eval_task(self, worker_id):
+        """Pop the first EVALUATION task only (reference
+        task_dispatcher.py:272-297)."""
+        with self._lock:
+            for i, task in enumerate(self._todo):
+                if task.type == pb.EVALUATION:
+                    self._todo.pop(i)
+                    task_id = self._next_task_id
+                    self._next_task_id += 1
+                    self._doing[task_id] = (worker_id, task, time.time())
+                    return task_id, task
+            return -1, None
+
+    def report(self, task_id, success, err_message=""):
+        """Worker finished (or failed) a task. Failed tasks are re-queued at
+        the front until retries are exhausted, which fails the job."""
+        with self._lock:
+            entry = self._doing.pop(task_id, None)
+            if entry is None:
+                logger.warning("Unknown task id reported: %d", task_id)
+                return None
+            worker_id, task, start_time = entry
+            if success:
+                self._task_durations.setdefault(
+                    task.type, collections.deque(maxlen=100)
+                ).append(time.time() - start_time)
+                evaluation_done = task.type == pb.EVALUATION
+                job_done = self._finished_locked()
+            elif self._stop_training and task.type == pb.TRAINING:
+                # Early stop: don't resurrect failed training tasks.
+                evaluation_done = False
+                job_done = self._finished_locked()
+            else:
+                task.retry_count += 1
+                if task.retry_count > self._max_task_retries:
+                    logger.error(
+                        "Task %s failed %d times (last: %s); failing job",
+                        task,
+                        task.retry_count,
+                        err_message,
+                    )
+                    self._job_failed = True
+                else:
+                    logger.warning(
+                        "Re-queueing failed task %s (%s)", task, err_message
+                    )
+                    self._todo.insert(0, task)
+                evaluation_done = False
+                job_done = False
+        # Callbacks run outside the lock: they may call back into us.
+        if success and evaluation_done:
+            for cb in self._eval_complete_callbacks:
+                cb(task_id, task)
+        if success and job_done:
+            for cb in self._tasks_done_callbacks:
+                cb()
+        return task
+
+    def recover_tasks(self, worker_id):
+        """Re-queue every in-flight task owned by a dead worker (reference
+        task_dispatcher.py:365-377). Called by the instance manager on pod
+        failure and by the timeout watchdog."""
+        with self._lock:
+            ids = [
+                tid
+                for tid, (wid, _, _) in self._doing.items()
+                if wid == worker_id
+            ]
+            for tid in ids:
+                _, task, _ = self._doing.pop(tid)
+                if self._stop_training and task.type == pb.TRAINING:
+                    continue
+                self._todo.insert(0, task)
+        if ids:
+            logger.info(
+                "Recovered %d tasks from worker %d", len(ids), worker_id
+            )
+
+    # ---------- status ----------
+
+    def _finished_locked(self):
+        epochs_exhausted = (
+            not self._training_shards
+            or self._epoch >= self._num_epochs
+            or self._stop_training
+        )
+        return (not self._todo) and (not self._doing) and epochs_exhausted
+
+    def finished(self):
+        with self._lock:
+            return self._stop_training or self._finished_locked()
+
+    @property
+    def job_failed(self):
+        return self._job_failed
+
+    def stop_training(self):
+        """Early-stop hook (max-steps / callback driven, reference
+        task_dispatcher.py:134-141)."""
+        with self._lock:
+            self._stop_training = True
+            self._todo = [t for t in self._todo if t.type != pb.TRAINING]
+
+    def doing_tasks_over_timeout(self, factor=3.0, min_samples=5):
+        """Worker ids whose in-flight task has run > factor x the rolling mean
+        completion time for its type (reference master/master.py:487-509)."""
+        now = time.time()
+        with self._lock:
+            slow_workers = set()
+            for tid, (wid, task, start) in self._doing.items():
+                durations = self._task_durations.get(task.type, [])
+                if len(durations) < min_samples:
+                    continue
+                mean = sum(durations) / len(durations)
+                if now - start > factor * max(mean, 1e-3):
+                    slow_workers.add(wid)
+            return slow_workers
+
+    def add_evaluation_complete_callback(self, cb):
+        self._eval_complete_callbacks.append(cb)
+
+    def add_tasks_done_callback(self, cb):
+        self._tasks_done_callbacks.append(cb)
+
+    def counts(self):
+        with self._lock:
+            return {"todo": len(self._todo), "doing": len(self._doing)}

@@ -1,0 +1,61 @@
+"""Worker-side wrapper over the Master service stub (reference
+/root/reference/elasticdl/python/worker/master_client.py:20-117)."""
+
+import numpy as np
+
+from elasticdl_tpu.common import rpc, tensor_utils
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+
+class MasterClient:
+    def __init__(self, master_addr, worker_id, worker_host=""):
+        self._channel = rpc.build_channel(master_addr)
+        self._stub = rpc.Stub(self._channel, rpc.MASTER_SERVICE)
+        self._worker_id = worker_id
+        self._worker_host = worker_host
+
+    def get_task(self, task_type=pb.TRAINING):
+        return self._stub.get_task(
+            pb.GetTaskRequest(
+                worker_id=self._worker_id, task_type=task_type
+            )
+        )
+
+    def report_task_result(self, task_id, err_message="", exec_counters=None):
+        req = pb.ReportTaskResultRequest(
+            task_id=task_id, err_message=err_message
+        )
+        if exec_counters:
+            for k, v in exec_counters.items():
+                req.exec_counters[k] = int(v)
+        return self._stub.report_task_result(req)
+
+    def report_evaluation_metrics(self, model_outputs, labels):
+        req = pb.ReportEvaluationMetricsRequest(
+            model_outputs=[
+                tensor_utils.ndarray_to_tensor_pb(np.asarray(model_outputs))
+            ],
+            labels=tensor_utils.ndarray_to_tensor_pb(np.asarray(labels)),
+            worker_id=self._worker_id,
+        )
+        return self._stub.report_evaluation_metrics(req)
+
+    def report_version(self, model_version):
+        return self._stub.report_version(
+            pb.ReportVersionRequest(model_version=model_version)
+        )
+
+    def get_comm_rank(self):
+        return self._stub.get_comm_rank(
+            pb.GetCommRankRequest(worker_host=self._worker_host)
+        )
+
+    def report_liveness(self):
+        return self._stub.report_worker_liveness(
+            pb.ReportWorkerLivenessRequest(
+                worker_id=self._worker_id, host=self._worker_host
+            )
+        )
+
+    def close(self):
+        self._channel.close()

@@ -1,0 +1,93 @@
+"""Tier-2 test: real MasterServicer over localhost gRPC driven by a real
+MasterClient."""
+
+import numpy as np
+
+from elasticdl_tpu.common.evaluation_utils import accuracy_metric
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.worker.master_client import MasterClient
+
+from test_utils import start_master
+
+
+def test_task_pull_report_finish_cycle():
+    with start_master(
+        training_shards={"f": (0, 40)}, records_per_task=20
+    ) as m:
+        mc = MasterClient(m["addr"], worker_id=0)
+        t1 = mc.get_task()
+        t2 = mc.get_task()
+        assert {t1.start, t2.start} == {0, 20}
+        # Queue drained but job unfinished -> WAIT.
+        t3 = mc.get_task()
+        assert t3.task_id == -1 and t3.type == pb.WAIT
+        mc.report_task_result(t1.task_id)
+        mc.report_task_result(t2.task_id)
+        t4 = mc.get_task()
+        assert t4.task_id == -1 and t4.type != pb.WAIT  # job done
+        assert m["task_d"].finished()
+        mc.close()
+
+
+def test_failed_task_is_requeued_via_rpc():
+    with start_master(
+        training_shards={"f": (0, 10)}, records_per_task=10
+    ) as m:
+        mc = MasterClient(m["addr"], worker_id=0)
+        t = mc.get_task()
+        mc.report_task_result(t.task_id, err_message="OOM")
+        t2 = mc.get_task()
+        assert t2.start == t.start and t2.end == t.end
+        mc.close()
+
+
+def test_version_triggered_evaluation_end_to_end():
+    with start_master(
+        training_shards={"f": (0, 10)},
+        evaluation_shards={"e": (0, 20)},
+        records_per_task=10,
+        eval_metrics_factory=lambda: {"accuracy": accuracy_metric()},
+        eval_steps=10,
+    ) as m:
+        mc = MasterClient(m["addr"], worker_id=0)
+        # Below threshold: no eval tasks yet.
+        mc.report_version(5)
+        assert mc.get_task(pb.EVALUATION).task_id == -1
+        # Crossing eval_steps creates 2 eval tasks (20 records / 10).
+        mc.report_version(10)
+        outputs = np.array([[0.9, 0.1], [0.2, 0.8]], dtype=np.float32)
+        labels = np.array([0, 0], dtype=np.int64)  # one right, one wrong
+        for _ in range(2):
+            t = mc.get_task(pb.EVALUATION)
+            assert t.type == pb.EVALUATION and t.model_version == 10
+            mc.report_evaluation_metrics(outputs, labels)
+            mc.report_task_result(t.task_id)
+        results = m["evaluation_service"].completed_results
+        assert len(results) == 1
+        version, metrics = results[0]
+        assert version == 10
+        np.testing.assert_allclose(metrics["accuracy"], 0.5)
+        mc.close()
+
+
+def test_comm_rank_and_membership_epochs():
+    with start_master(
+        training_shards={"f": (0, 10)}, with_membership=True
+    ) as m:
+        w0 = MasterClient(m["addr"], worker_id=0, worker_host="host-a")
+        w1 = MasterClient(m["addr"], worker_id=1, worker_host="host-b")
+        w0.report_liveness()
+        w1.report_liveness()
+        r0, r1 = w0.get_comm_rank(), w1.get_comm_rank()
+        assert {r0.rank_id, r1.rank_id} == {0, 1}
+        assert r0.world_size == 2 and r0.rendezvous_id == r1.rendezvous_id
+        assert r0.coordinator_addr.startswith("host-a:")
+        epoch_before = r0.rendezvous_id
+        # host-b dies: epoch bumps, survivor keeps rank 0.
+        m["membership"].remove_worker_host("host-b")
+        r0b = w0.get_comm_rank()
+        assert r0b.world_size == 1 and r0b.rendezvous_id == epoch_before + 1
+        assert r0b.rank_id == 0
+        # Liveness timestamps recorded for the watchdog.
+        assert set(m["servicer"].worker_liveness) == {0, 1}
+        w0.close(); w1.close()

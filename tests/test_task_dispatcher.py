@@ -1,0 +1,126 @@
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+
+def make_dispatcher(**kwargs):
+    defaults = dict(
+        training_shards={"f1": (0, 100), "f2": (50, 50)},
+        records_per_task=30,
+        num_epochs=1,
+        shuffle=False,
+    )
+    defaults.update(kwargs)
+    return TaskDispatcher(**defaults)
+
+
+def drain(task_d, worker_id=0):
+    tasks = []
+    while True:
+        tid, task = task_d.get(worker_id)
+        if task is None:
+            break
+        tasks.append((tid, task))
+    return tasks
+
+
+def test_task_partitioning_covers_all_records():
+    task_d = make_dispatcher()
+    tasks = drain(task_d)
+    # f1: [0,100) in chunks of 30 -> 4 tasks; f2: [50,100) -> 2 tasks
+    assert len(tasks) == 6
+    covered = {}
+    for _, t in tasks:
+        covered.setdefault(t.shard_name, []).append((t.start, t.end))
+    assert sorted(covered["f1"]) == [(0, 30), (30, 60), (60, 90), (90, 100)]
+    assert sorted(covered["f2"]) == [(50, 80), (80, 100)]
+
+
+def test_epochs_regenerate_tasks():
+    task_d = make_dispatcher(
+        training_shards={"f": (0, 10)}, records_per_task=10, num_epochs=3
+    )
+    seen = 0
+    while True:
+        tid, task = task_d.get(0)
+        if task is None:
+            break
+        seen += 1
+        task_d.report(tid, True)
+    assert seen == 3
+    assert task_d.finished()
+
+
+def test_not_finished_until_doing_drains():
+    task_d = make_dispatcher(
+        training_shards={"f": (0, 10)}, records_per_task=10
+    )
+    tid, task = task_d.get(0)
+    assert not task_d.finished()  # still in doing
+    task_d.report(tid, True)
+    assert task_d.finished()
+
+
+def test_failed_task_requeued_then_job_fails():
+    task_d = make_dispatcher(
+        training_shards={"f": (0, 10)}, records_per_task=10,
+        max_task_retries=2,
+    )
+    for attempt in range(3):
+        tid, task = task_d.get(0)
+        assert task is not None, f"attempt {attempt}: task should be requeued"
+        task_d.report(tid, False, "boom")
+    assert task_d.job_failed
+    assert task_d.get(0) == (-1, None)
+
+
+def test_recover_tasks_of_dead_worker():
+    task_d = make_dispatcher(
+        training_shards={"f": (0, 60)}, records_per_task=30
+    )
+    t1, _ = task_d.get(worker_id=1)
+    t2, _ = task_d.get(worker_id=2)
+    assert task_d.counts() == {"todo": 0, "doing": 2}
+    task_d.recover_tasks(worker_id=1)
+    assert task_d.counts() == {"todo": 1, "doing": 1}
+    # Recovered task is re-dispatchable; reporting the old id is ignored.
+    task_d.report(t1, True)
+    t3, task3 = task_d.get(worker_id=3)
+    assert task3 is not None
+
+
+def test_eval_tasks_prioritized_and_filtered():
+    task_d = make_dispatcher(
+        training_shards={"f": (0, 30)},
+        evaluation_shards={"e": (0, 20)},
+        records_per_task=10,
+    )
+    task_d.create_evaluation_tasks(model_version=5)
+    tid, task = task_d.get_eval_task(0)
+    assert task.type == pb.EVALUATION and task.model_version == 5
+    # get() also serves eval tasks (they sit at the queue front).
+    _, t2 = task_d.get(0)
+    assert t2.type == pb.EVALUATION
+
+
+def test_shuffle_is_deterministic_with_seed():
+    order1 = [t.start for _, t in drain(make_dispatcher(shuffle=True, seed=7))]
+    order2 = [t.start for _, t in drain(make_dispatcher(shuffle=True, seed=7))]
+    assert order1 == order2
+
+
+def test_stop_training_drops_training_tasks():
+    task_d = make_dispatcher(num_epochs=10)
+    task_d.get(0)
+    task_d.stop_training()
+    assert task_d.get(0) == (-1, None)
+
+
+def test_train_end_callback_task():
+    task_d = make_dispatcher(
+        training_shards={"f": (0, 10)}, records_per_task=10
+    )
+    tid, task = task_d.get(0)
+    task_d.report(tid, True)
+    task_d.create_train_end_callback_task()
+    tid, task = task_d.get(0)
+    assert task.type == pb.TRAIN_END_CALLBACK

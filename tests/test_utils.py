@@ -1,0 +1,51 @@
+"""Shared helpers for in-process distributed tests: boot a REAL master gRPC
+server on a free localhost port (the reference's signature test pattern,
+/root/reference/elasticdl/python/tests/mock_service.py:34-43)."""
+
+import contextlib
+
+from elasticdl_tpu.common import rpc
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.membership import MembershipManager
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+
+@contextlib.contextmanager
+def start_master(
+    training_shards=None,
+    evaluation_shards=None,
+    prediction_shards=None,
+    records_per_task=10,
+    num_epochs=1,
+    shuffle=False,
+    eval_metrics_factory=None,
+    eval_steps=0,
+    with_membership=False,
+):
+    task_d = TaskDispatcher(
+        training_shards or {},
+        evaluation_shards,
+        prediction_shards,
+        records_per_task=records_per_task,
+        num_epochs=num_epochs,
+        shuffle=shuffle,
+    )
+    evaluation_service = None
+    if eval_metrics_factory is not None:
+        evaluation_service = EvaluationService(
+            task_d, eval_metrics_factory, eval_steps=eval_steps
+        )
+    membership = MembershipManager() if with_membership else None
+    servicer = MasterServicer(task_d, evaluation_service, membership)
+    server, port = rpc.serve(servicer, rpc.MASTER_SERVICE, port=0)
+    try:
+        yield {
+            "addr": f"localhost:{port}",
+            "task_d": task_d,
+            "servicer": servicer,
+            "evaluation_service": evaluation_service,
+            "membership": membership,
+        }
+    finally:
+        server.stop(0)
