@@ -1,0 +1,61 @@
+"""Benchmark entrypoint: prints ONE JSON line
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Measures training throughput (examples/sec/chip) of the current flagship
+model on the available device. Baseline comparison: the reference's best
+published single-accelerator number for an image CNN — ResNet50/ImageNet on
+one P100 at 145 img/s (BASELINE.md, ftlib_benchmark.md:114-135). Models are
+not identical across frameworks, so vs_baseline is a coarse chips-vs-GPUs
+throughput ratio until the resnet50 zoo config lands.
+"""
+
+import json
+import time
+
+import jax
+import numpy as np
+
+
+def bench_train_throughput(batch_size=256, steps=30, warmup=5):
+    from elasticdl_tpu.common.model_utils import get_model_spec
+    from elasticdl_tpu.worker.trainer import LocalTrainer
+
+    spec = get_model_spec("elasticdl_tpu.models.mnist.mnist_model")
+    trainer = LocalTrainer(
+        spec.build_model(), spec.loss, spec.build_optimizer_spec()
+    )
+    rng = np.random.default_rng(0)
+    features = rng.normal(size=(batch_size, 28, 28)).astype(np.float32)
+    labels = rng.integers(0, 10, batch_size).astype(np.int64)
+
+    for _ in range(warmup):
+        trainer.train_minibatch(features, labels)
+    jax.block_until_ready(trainer._variables)
+
+    start = time.perf_counter()
+    for _ in range(steps):
+        trainer.train_minibatch(features, labels)
+    jax.block_until_ready(trainer._variables)
+    elapsed = time.perf_counter() - start
+    return batch_size * steps / elapsed
+
+
+def main():
+    examples_per_sec = bench_train_throughput()
+    n_devices = max(jax.local_device_count(), 1)
+    per_chip = examples_per_sec / n_devices
+    baseline_img_per_sec = 145.0  # reference ResNet50/ImageNet, 1x P100
+    print(
+        json.dumps(
+            {
+                "metric": "examples/sec/chip (MnistCNN train step, batch 256)",
+                "value": round(per_chip, 2),
+                "unit": "examples/sec",
+                "vs_baseline": round(per_chip / baseline_img_per_sec, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

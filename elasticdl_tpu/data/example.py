@@ -1,0 +1,40 @@
+"""Example codec: dict[str, np.ndarray] <-> bytes via the Example proto.
+
+The framework's stable on-disk training-example format (replaces the
+reference's TF Example usage in its dataset converters,
+/root/reference/elasticdl/python/data/recordio_gen/).
+"""
+
+import numpy as np
+
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+
+def encode_example(features: dict) -> bytes:
+    ex = pb.Example()
+    for name, value in features.items():
+        ex.features[name].CopyFrom(
+            tensor_utils.ndarray_to_tensor_pb(np.asarray(value), name)
+        )
+    return ex.SerializeToString()
+
+
+def decode_example(data: bytes) -> dict:
+    ex = pb.Example()
+    ex.ParseFromString(data)
+    return {
+        name: tensor_utils.tensor_pb_to_ndarray(t)
+        for name, t in ex.features.items()
+    }
+
+
+def batch_examples(records):
+    """Decode and stack a list of serialized Examples into a feature batch:
+    {name: array of shape [batch, ...]}."""
+    decoded = [decode_example(r) for r in records]
+    if not decoded:
+        return {}
+    return {
+        name: np.stack([d[name] for d in decoded]) for name in decoded[0]
+    }

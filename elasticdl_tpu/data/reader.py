@@ -1,0 +1,141 @@
+"""Data readers: turn a Task's (shard_name, start, end) into records.
+
+Mirrors the reference reader contract (/root/reference/elasticdl/python/data/
+reader/data_reader.py:19-114): `read_records(task)` yields raw records for the
+task's range; `create_shards()` returns {shard_name: (start, num_records)} for
+the master to partition into tasks.
+"""
+
+import csv
+import glob
+import os
+from abc import ABC, abstractmethod
+
+from elasticdl_tpu.data.recordfile import RecordFile
+
+
+class Metadata:
+    def __init__(self, column_names=None):
+        self.column_names = column_names or []
+
+
+class AbstractDataReader(ABC):
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    @abstractmethod
+    def read_records(self, task):
+        """Yield records (bytes or tuples) for task.start..task.end within
+        task.shard_name."""
+
+    @abstractmethod
+    def create_shards(self):
+        """Return {shard_name: (start_index, num_records)}."""
+
+    @property
+    def metadata(self):
+        return Metadata()
+
+
+class RecordFileReader(AbstractDataReader):
+    """Reads .edlr record files; one shard per file."""
+
+    def __init__(self, data_dir, **kwargs):
+        super().__init__(**kwargs)
+        self._data_dir = data_dir
+        self._files = {}  # path -> RecordFile, opened lazily and cached
+
+    def _record_file(self, path):
+        if path not in self._files:
+            self._files[path] = RecordFile(path)
+        return self._files[path]
+
+    def read_records(self, task):
+        rf = self._record_file(task.shard_name)
+        yield from rf.read(task.start, task.end - task.start)
+
+    def create_shards(self):
+        shards = {}
+        for path in sorted(glob.glob(os.path.join(self._data_dir, "*.edlr"))):
+            shards[path] = (0, RecordFile(path).num_records)
+        if not shards:
+            raise ValueError(f"no .edlr record files under {self._data_dir}")
+        return shards
+
+    def close(self):
+        for rf in self._files.values():
+            rf.close()
+        self._files.clear()
+
+
+class CSVDataReader(AbstractDataReader):
+    """Reads rows of one CSV file by index range (reference
+    csv_reader.py:26-75). Records are tuples of strings."""
+
+    def __init__(self, data_path, sep=",", with_header=False, **kwargs):
+        super().__init__(**kwargs)
+        self._path = data_path
+        self._sep = sep
+        self._with_header = with_header
+        self._columns = None
+        if with_header:
+            with open(self._path, newline="") as f:
+                self._columns = next(csv.reader(f, delimiter=self._sep))
+
+    def read_records(self, task):
+        skip = 1 if self._with_header else 0
+        with open(self._path, newline="") as f:
+            reader = csv.reader(f, delimiter=self._sep)
+            for i, row in enumerate(reader):
+                idx = i - skip
+                if idx < task.start:
+                    continue
+                if idx >= task.end:
+                    break
+                if i < skip:
+                    continue
+                yield tuple(row)
+
+    def create_shards(self):
+        skip = 1 if self._with_header else 0
+        with open(self._path, newline="") as f:
+            count = sum(1 for _ in csv.reader(f, delimiter=self._sep)) - skip
+        return {self._path: (0, count)}
+
+    @property
+    def metadata(self):
+        return Metadata(column_names=self._columns)
+
+
+class InMemoryReader(AbstractDataReader):
+    """Serves records from an in-memory list — used by tests and local runs
+    the way the reference uses generated RecordIO fixtures
+    (/root/reference/elasticdl/python/tests/test_utils.py:103)."""
+
+    def __init__(self, records, shard_name="memory", **kwargs):
+        super().__init__(**kwargs)
+        self._records = list(records)
+        self._shard_name = shard_name
+
+    def read_records(self, task):
+        yield from self._records[task.start : task.end]
+
+    def create_shards(self):
+        return {self._shard_name: (0, len(self._records))}
+
+
+def create_data_reader(data_origin, records_per_task=None, **kwargs):
+    """Factory sniffing the origin type (reference
+    data_reader_factory.py:23-73)."""
+    if isinstance(data_origin, AbstractDataReader):
+        return data_origin
+    if isinstance(data_origin, (list, tuple)):
+        return InMemoryReader(data_origin, **kwargs)
+    if os.path.isdir(data_origin):
+        return RecordFileReader(data_origin, **kwargs)
+    if data_origin.endswith(".csv"):
+        return CSVDataReader(data_origin, **kwargs)
+    if data_origin.endswith(".edlr"):
+        d = os.path.dirname(data_origin) or "."
+        return RecordFileReader(d, **kwargs)
+    raise ValueError(f"cannot infer a data reader for: {data_origin!r}")

@@ -1,0 +1,117 @@
+"""Seekable record file format (.edlr): the framework's RecordIO equivalent.
+
+The reference reads RecordIO shards by (file, start, count) range
+(/root/reference/elasticdl/python/data/reader/recordio_reader.py:27-62).
+This format supports the same access pattern with O(1) seeks:
+
+    [magic "EDLR"][u32 version]
+    [u32 len][record bytes] ...          # the records
+    [u64 offset] * num_records           # footer: offset of each record
+    [u64 num_records][u64 index_offset][magic "EDLI"]
+
+Written records are opaque bytes; the framework stores Example protos in them
+but any payload works.
+"""
+
+import os
+import struct
+
+_MAGIC = b"EDLR"
+_FOOTER_MAGIC = b"EDLI"
+_VERSION = 1
+_FOOTER_TAIL = struct.Struct("<QQ4s")  # num_records, index_offset, magic
+_LEN = struct.Struct("<I")
+_OFF = struct.Struct("<Q")
+
+
+class RecordFileWriter:
+    def __init__(self, path):
+        self._f = open(path, "wb")
+        self._f.write(_MAGIC)
+        self._f.write(struct.pack("<I", _VERSION))
+        self._offsets = []
+        self._closed = False
+
+    def write(self, record: bytes):
+        self._offsets.append(self._f.tell())
+        self._f.write(_LEN.pack(len(record)))
+        self._f.write(record)
+
+    def close(self):
+        if self._closed:
+            return
+        index_offset = self._f.tell()
+        for off in self._offsets:
+            self._f.write(_OFF.pack(off))
+        self._f.write(
+            _FOOTER_TAIL.pack(len(self._offsets), index_offset, _FOOTER_MAGIC)
+        )
+        self._f.close()
+        self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class RecordFile:
+    """Random-access reader over a .edlr file."""
+
+    def __init__(self, path):
+        self.path = path
+        self._f = open(path, "rb")
+        if self._f.read(4) != _MAGIC:
+            raise ValueError(f"{path} is not a record file (bad magic)")
+        (version,) = struct.unpack("<I", self._f.read(4))
+        if version != _VERSION:
+            raise ValueError(f"{path}: unsupported record file version {version}")
+        self._f.seek(-_FOOTER_TAIL.size, os.SEEK_END)
+        num, index_offset, magic = _FOOTER_TAIL.unpack(
+            self._f.read(_FOOTER_TAIL.size)
+        )
+        if magic != _FOOTER_MAGIC:
+            raise ValueError(
+                f"{path}: truncated or corrupt record file (bad footer)"
+            )
+        self.num_records = num
+        self._index_offset = index_offset
+
+    def _record_offset(self, i):
+        self._f.seek(self._index_offset + i * _OFF.size)
+        (off,) = _OFF.unpack(self._f.read(_OFF.size))
+        return off
+
+    def read(self, start: int, count: int):
+        """Yield `count` records beginning at record index `start`.
+
+        Records are contiguous on disk, so after one seek the range is a
+        sequential scan — the access pattern task dispatch relies on.
+        """
+        if start < 0 or start + count > self.num_records:
+            raise IndexError(
+                f"range [{start}, {start + count}) out of bounds "
+                f"for {self.num_records} records"
+            )
+        if count == 0:
+            return
+        self._f.seek(self._record_offset(start))
+        for _ in range(count):
+            (length,) = _LEN.unpack(self._f.read(_LEN.size))
+            yield self._f.read(length)
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_records(path, records):
+    with RecordFileWriter(path) as w:
+        for r in records:
+            w.write(r)

@@ -78,7 +78,7 @@ class TaskDispatcher:
 
         self._epoch = 0
         self._next_task_id = 0
-        self._todo = []  # list of _Task, consumed from the front
+        self._todo = collections.deque()  # _Task queue, consumed from the front
         self._doing = {}  # task_id -> (worker_id, _Task, start_time)
         self._job_failed = False
         self._stop_training = False
@@ -115,7 +115,8 @@ class TaskDispatcher:
         if task_type == pb.TRAINING and self._shuffle:
             self._rng.shuffle(tasks)
         if at_front:
-            self._todo = tasks + self._todo
+            # extendleft reverses; pre-reverse to preserve task order.
+            self._todo.extendleft(reversed(tasks))
         else:
             self._todo.extend(tasks)
         return len(tasks)
@@ -156,7 +157,7 @@ class TaskDispatcher:
                 self._create_tasks_locked(pb.TRAINING)
             if not self._todo:
                 return -1, None
-            task = self._todo.pop(0)
+            task = self._todo.popleft()
             task_id = self._next_task_id
             self._next_task_id += 1
             self._doing[task_id] = (worker_id, task, time.time())
@@ -168,7 +169,7 @@ class TaskDispatcher:
         with self._lock:
             for i, task in enumerate(self._todo):
                 if task.type == pb.EVALUATION:
-                    self._todo.pop(i)
+                    del self._todo[i]
                     task_id = self._next_task_id
                     self._next_task_id += 1
                     self._doing[task_id] = (worker_id, task, time.time())
@@ -204,11 +205,14 @@ class TaskDispatcher:
                         err_message,
                     )
                     self._job_failed = True
+                    # Terminal: drop remaining work so workers drain and
+                    # exit; the master process checks job_failed.
+                    self._todo.clear()
                 else:
                     logger.warning(
                         "Re-queueing failed task %s (%s)", task, err_message
                     )
-                    self._todo.insert(0, task)
+                    self._todo.appendleft(task)
                 evaluation_done = False
                 job_done = False
         # Callbacks run outside the lock: they may call back into us.
@@ -234,7 +238,7 @@ class TaskDispatcher:
                 _, task, _ = self._doing.pop(tid)
                 if self._stop_training and task.type == pb.TRAINING:
                     continue
-                self._todo.insert(0, task)
+                self._todo.appendleft(task)
         if ids:
             logger.info(
                 "Recovered %d tasks from worker %d", len(ids), worker_id
@@ -251,8 +255,11 @@ class TaskDispatcher:
         return (not self._todo) and (not self._doing) and epochs_exhausted
 
     def finished(self):
+        # NB: after stop_training() this still waits for in-flight tasks and
+        # queued evaluation tasks to drain (_finished_locked treats the
+        # remaining epochs as exhausted) so final evals are not orphaned.
         with self._lock:
-            return self._stop_training or self._finished_locked()
+            return self._finished_locked()
 
     @property
     def job_failed(self):
@@ -263,7 +270,9 @@ class TaskDispatcher:
         task_dispatcher.py:134-141)."""
         with self._lock:
             self._stop_training = True
-            self._todo = [t for t in self._todo if t.type != pb.TRAINING]
+            self._todo = collections.deque(
+                t for t in self._todo if t.type != pb.TRAINING
+            )
 
     def doing_tasks_over_timeout(self, factor=3.0, min_samples=5):
         """Worker ids whose in-flight task has run > factor x the rolling mean

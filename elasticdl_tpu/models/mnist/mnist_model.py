@@ -1,0 +1,63 @@
+"""MNIST CNN — the zoo's hello-world model.
+
+Reference counterpart: /root/reference/model_zoo/mnist/
+mnist_functional_api.py:21-103 (Conv 32 / Conv 64 / BatchNorm / MaxPool /
+Dense 1024 / Dense 10, SGD(0.01), sparse softmax CE). Rebuilt as a flax
+module; compute stays NHWC + bfloat16-friendly so XLA tiles the convs onto
+the MXU.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from elasticdl_tpu.common.evaluation_utils import accuracy_metric
+from elasticdl_tpu.common.model_utils import Modes
+from elasticdl_tpu.data.example import batch_examples
+from elasticdl_tpu.ops import optimizers
+
+
+class MnistCNN(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        # Accept [B, 28*28] or [B, 28, 28]; conv in NHWC.
+        x = x.reshape(x.shape[0], 28, 28, 1)
+        x = nn.Conv(32, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.BatchNorm(use_running_average=not training)(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dense(1024)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+def custom_model():
+    return MnistCNN()
+
+
+def loss(labels, predictions):
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(
+            predictions, labels.reshape(-1)
+        )
+    )
+
+
+def optimizer(lr=0.01):
+    return optimizers.momentum(learning_rate=lr)
+
+
+def feed(records, mode, metadata):
+    batch = batch_examples(records)
+    features = batch["image"].astype("float32")
+    labels = batch["label"] if mode != Modes.PREDICTION else None
+    return features, labels
+
+
+def eval_metrics_fn():
+    return {"accuracy": accuracy_metric()}

@@ -1,0 +1,120 @@
+"""Optimizer descriptors usable on both sides of the wire.
+
+The reference extracts (opt_type, opt_args) from a live Keras optimizer to
+re-instantiate it inside the Go parameter server
+(/root/reference/elasticdl/python/common/model_utils.py:227,
+go/pkg/ps/optimizer.go:329-390). Here the model zoo exports an OptimizerSpec
+directly; the worker materializes it as an optax transform (for local /
+AllReduce training where the update runs on-TPU), and the parameter server
+materializes the same spec against its host-resident store via the native
+C++ kernels (elasticdl_tpu/native).
+"""
+
+import optax
+
+# name -> (constructor kwargs accepted, default values)
+_SUPPORTED = {
+    "sgd": {"learning_rate": 0.1},
+    "momentum": {"learning_rate": 0.1, "momentum": 0.9, "nesterov": False},
+    "adam": {
+        "learning_rate": 0.001,
+        "beta_1": 0.9,
+        "beta_2": 0.999,
+        "epsilon": 1e-8,
+        "amsgrad": False,
+    },
+    "adagrad": {"learning_rate": 0.1, "initial_accumulator_value": 0.1,
+                "epsilon": 1e-7},
+}
+
+
+class OptimizerSpec:
+    def __init__(self, name, **hyperparams):
+        name = name.lower()
+        if name not in _SUPPORTED:
+            raise ValueError(
+                f"unsupported optimizer {name!r}; choose from "
+                f"{sorted(_SUPPORTED)}"
+            )
+        unknown = set(hyperparams) - set(_SUPPORTED[name])
+        if unknown:
+            raise ValueError(f"unknown {name} hyperparams: {sorted(unknown)}")
+        self.name = name
+        self.hyperparams = {**_SUPPORTED[name], **hyperparams}
+
+    @property
+    def learning_rate(self):
+        return self.hyperparams["learning_rate"]
+
+    def to_optax(self) -> optax.GradientTransformation:
+        h = self.hyperparams
+        if self.name == "sgd":
+            return optax.sgd(h["learning_rate"])
+        if self.name == "momentum":
+            return optax.sgd(
+                h["learning_rate"],
+                momentum=h["momentum"],
+                nesterov=h["nesterov"],
+            )
+        if self.name == "adam":
+            if h["amsgrad"]:
+                return optax.amsgrad(
+                    h["learning_rate"],
+                    b1=h["beta_1"],
+                    b2=h["beta_2"],
+                    eps=h["epsilon"],
+                )
+            return optax.adam(
+                h["learning_rate"],
+                b1=h["beta_1"],
+                b2=h["beta_2"],
+                eps=h["epsilon"],
+            )
+        if self.name == "adagrad":
+            return optax.adagrad(
+                h["learning_rate"],
+                initial_accumulator_value=h["initial_accumulator_value"],
+                eps=h["epsilon"],
+            )
+        raise AssertionError(self.name)
+
+    def to_flags(self):
+        """(name, hyperparams) for re-instantiation inside a PS process."""
+        return self.name, dict(self.hyperparams)
+
+    def __repr__(self):
+        return f"OptimizerSpec({self.name}, {self.hyperparams})"
+
+
+def sgd(learning_rate=0.1):
+    return OptimizerSpec("sgd", learning_rate=learning_rate)
+
+
+def momentum(learning_rate=0.1, momentum_value=0.9, nesterov=False):
+    return OptimizerSpec(
+        "momentum",
+        learning_rate=learning_rate,
+        momentum=momentum_value,
+        nesterov=nesterov,
+    )
+
+
+def adam(learning_rate=0.001, beta_1=0.9, beta_2=0.999, epsilon=1e-8,
+         amsgrad=False):
+    return OptimizerSpec(
+        "adam",
+        learning_rate=learning_rate,
+        beta_1=beta_1,
+        beta_2=beta_2,
+        epsilon=epsilon,
+        amsgrad=amsgrad,
+    )
+
+
+def adagrad(learning_rate=0.1, initial_accumulator_value=0.1, epsilon=1e-7):
+    return OptimizerSpec(
+        "adagrad",
+        learning_rate=learning_rate,
+        initial_accumulator_value=initial_accumulator_value,
+        epsilon=epsilon,
+    )

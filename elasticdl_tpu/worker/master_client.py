@@ -31,9 +31,14 @@ class MasterClient:
         return self._stub.report_task_result(req)
 
     def report_evaluation_metrics(self, model_outputs, labels):
+        # Multi-output models pass a list/tuple; each output goes on the wire
+        # as its own tensor so the master can hand metrics the same list.
+        if not isinstance(model_outputs, (list, tuple)):
+            model_outputs = [model_outputs]
         req = pb.ReportEvaluationMetricsRequest(
             model_outputs=[
-                tensor_utils.ndarray_to_tensor_pb(np.asarray(model_outputs))
+                tensor_utils.ndarray_to_tensor_pb(np.asarray(o))
+                for o in model_outputs
             ],
             labels=tensor_utils.ndarray_to_tensor_pb(np.asarray(labels)),
             worker_id=self._worker_id,

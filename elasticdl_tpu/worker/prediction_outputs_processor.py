@@ -1,0 +1,11 @@
+"""User hook for handling prediction outputs (reference
+/root/reference/elasticdl/python/worker/prediction_outputs_processor.py:17-35).
+"""
+
+from abc import ABC, abstractmethod
+
+
+class BasePredictionOutputsProcessor(ABC):
+    @abstractmethod
+    def process(self, predictions, worker_id):
+        ...

@@ -1,0 +1,62 @@
+"""Task-to-minibatch pipeline on the worker.
+
+Reference counterpart (/root/reference/elasticdl/python/worker/
+task_data_service.py:26-238) adapts a stream of tasks into a tf.data
+generator with deferred completion accounting. TPU-first simplification:
+batches are task-scoped (a minibatch never spans tasks), so "task done" is
+exactly "all its minibatches processed" — the completion accounting the
+reference needed a pending-task deque for becomes trivial, and a recovered
+task re-runs whole.
+"""
+
+import time
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+logger = get_logger("worker.task_data_service")
+
+_WAIT_SLEEP_SECONDS = 0.5
+
+
+class TaskDataService:
+    def __init__(self, master_client, data_reader):
+        self._mc = master_client
+        self._reader = data_reader
+
+    def get_task(self, task_type=pb.TRAINING, wait=True):
+        """Next task from the master; blocks through WAIT states (queue
+        momentarily empty). Returns None when the job is finished."""
+        while True:
+            task = self._mc.get_task(task_type)
+            if task.task_id >= 0:
+                return task
+            if task.type == pb.WAIT and wait:
+                time.sleep(_WAIT_SLEEP_SECONDS)
+                continue
+            return None
+
+    def try_get_eval_task(self):
+        """Non-blocking eval-task poll for interleaving evaluation into the
+        training loop."""
+        task = self._mc.get_task(pb.EVALUATION)
+        return task if task.task_id >= 0 else None
+
+    def read_batches(self, task, batch_size):
+        """Yield lists of raw records for the task, batch_size at a time
+        (last batch may be smaller)."""
+        batch = []
+        for record in self._reader.read_records(task):
+            batch.append(record)
+            if len(batch) >= batch_size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
+    def report_task(self, task_id, err_message="", exec_counters=None):
+        self._mc.report_task_result(task_id, err_message, exec_counters)
+
+    @property
+    def data_reader(self):
+        return self._reader

@@ -1,0 +1,181 @@
+"""Trainer abstraction + the local (single-process) JAX trainer.
+
+Reference counterpart: the Trainer ABC and eager/`tf.function` training paths
+(/root/reference/elasticdl/python/worker/trainer.py:17-56,
+worker/ps_trainer.py:388-401). TPU-first redesign: the step is a pure jitted
+function over an explicit (variables, opt_state) pytree — XLA fuses the
+forward, backward and optimizer update into one program, and the same step
+function is reused by the AllReduce trainer under shard_map.
+"""
+
+from abc import ABC, abstractmethod
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("worker.trainer")
+
+
+class Trainer(ABC):
+    """What the worker loop needs from any training strategy."""
+
+    @abstractmethod
+    def init_variables_if_needed(self, features):
+        ...
+
+    @abstractmethod
+    def train_minibatch(self, features, labels):
+        """Returns (accepted: bool, model_version: int, loss: float)."""
+
+    @abstractmethod
+    def evaluate_minibatch(self, features, model_version=-1):
+        """Forward pass; returns model outputs (numpy)."""
+
+    def predict_minibatch(self, features):
+        return self.evaluate_minibatch(features)
+
+    @abstractmethod
+    def get_model_version(self) -> int:
+        ...
+
+    def export_variables(self):
+        """Checkpointable state; override where meaningful."""
+        return None
+
+
+def _to_device_batch(features):
+    """numpy batch (array or dict pytree) -> jnp arrays."""
+    return jax.tree_util.tree_map(jnp.asarray, features)
+
+
+class JaxTrainer(Trainer):
+    """Shared JAX machinery: lazy variable init, jitted train/forward steps.
+
+    Subclasses override `_build_train_step` / `_build_forward` to insert
+    collectives (AllReduce) or parameter-exchange hooks (PS).
+    """
+
+    def __init__(self, model, loss_fn, optimizer_spec, seed=0):
+        self._model = model
+        self._loss_fn = loss_fn
+        self._optimizer_spec = optimizer_spec
+        self._optax = optimizer_spec.to_optax()
+        self._rng = jax.random.PRNGKey(seed)
+        self._variables = None
+        self._opt_state = None
+        self._version = 0
+        self._train_step = None
+        self._forward = None
+
+    # ---------- init ----------
+
+    def init_variables_if_needed(self, features):
+        if self._variables is not None:
+            return
+        self._rng, init_rng = jax.random.split(self._rng)
+        device_features = _to_device_batch(features)
+        variables = self._model.init(
+            {"params": init_rng, "dropout": init_rng},
+            device_features,
+            training=False,
+        )
+        self._variables = jax.tree_util.tree_map(jnp.asarray, dict(variables))
+        self._opt_state = self._optax.init(self._variables["params"])
+        n_params = sum(
+            int(np.prod(p.shape))
+            for p in jax.tree_util.tree_leaves(self._variables["params"])
+        )
+        logger.info("Initialized model with %d parameters", n_params)
+        self._train_step = self._build_train_step()
+        self._forward = self._build_forward()
+
+    # ---------- step functions ----------
+
+    def _apply_train(self, params, state, rng, features, labels):
+        """Pure fwd+bwd+update; the body every strategy shares."""
+        mutable = [k for k in state]
+
+        def loss_of(p):
+            out = self._model.apply(
+                {"params": p, **state},
+                features,
+                training=True,
+                rngs={"dropout": rng},
+                mutable=mutable if mutable else False,
+            )
+            outputs, new_state = out if mutable else (out, state)
+            return self._loss_fn(labels, outputs), new_state
+
+        (loss, new_state), grads = jax.value_and_grad(
+            loss_of, has_aux=True
+        )(params)
+        return loss, grads, new_state
+
+    def _build_train_step(self):
+        def step(variables, opt_state, rng, features, labels):
+            params = variables["params"]
+            state = {k: v for k, v in variables.items() if k != "params"}
+            loss, grads, new_state = self._apply_train(
+                params, state, rng, features, labels
+            )
+            updates, new_opt_state = self._optax.update(
+                grads, opt_state, params
+            )
+            new_params = optax.apply_updates(params, updates)
+            return {"params": new_params, **new_state}, new_opt_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def _build_forward(self):
+        def forward(variables, features):
+            return self._model.apply(variables, features, training=False)
+
+        return jax.jit(forward)
+
+    # ---------- Trainer interface ----------
+
+    def train_minibatch(self, features, labels):
+        self.init_variables_if_needed(features)
+        self._rng, step_rng = jax.random.split(self._rng)
+        self._variables, self._opt_state, loss = self._train_step(
+            self._variables,
+            self._opt_state,
+            step_rng,
+            _to_device_batch(features),
+            _to_device_batch(labels),
+        )
+        self._version += 1
+        return True, self._version, float(loss)
+
+    def evaluate_minibatch(self, features, model_version=-1):
+        self.init_variables_if_needed(features)
+        outputs = self._forward(self._variables, _to_device_batch(features))
+        # Multi-output models return pytrees; hand numpy back either way.
+        return jax.tree_util.tree_map(np.asarray, outputs)
+
+    def get_model_version(self):
+        return self._version
+
+    def export_variables(self):
+        return {
+            "variables": jax.device_get(self._variables),
+            "version": self._version,
+        }
+
+    def restore_variables(self, exported):
+        self._variables = jax.tree_util.tree_map(
+            jnp.asarray, exported["variables"]
+        )
+        self._opt_state = self._optax.init(self._variables["params"])
+        self._version = exported["version"]
+        self._train_step = self._build_train_step()
+        self._forward = self._build_forward()
+
+
+class LocalTrainer(JaxTrainer):
+    """Single-chip training: the minimum end-to-end strategy (reference
+    DistributionStrategy.LOCAL)."""

@@ -1,0 +1,200 @@
+"""The worker loop: pull tasks, train/evaluate/predict minibatches, report.
+
+Reference counterpart (/root/reference/elasticdl/python/worker/
+worker.py:42-444): job-type dispatch, per-minibatch retry (<=64), evaluation
+tasks interleaved into training, prediction output processing, train-end
+callback task handling.
+"""
+
+import traceback
+
+from elasticdl_tpu.common.constants import (
+    DEFAULT_MAX_MINIBATCH_RETRY_NUM,
+    JobType,
+)
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.model_utils import Modes
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.worker.task_data_service import TaskDataService
+
+logger = get_logger("worker.worker")
+
+
+class Worker:
+    def __init__(
+        self,
+        worker_id,
+        master_client,
+        data_reader,
+        model_spec,
+        trainer,
+        minibatch_size=64,
+        job_type=JobType.TRAINING_ONLY,
+        log_loss_steps=100,
+        max_minibatch_retries=DEFAULT_MAX_MINIBATCH_RETRY_NUM,
+    ):
+        self._worker_id = worker_id
+        self._mc = master_client
+        self._tds = TaskDataService(master_client, data_reader)
+        self._spec = model_spec
+        self._trainer = trainer
+        self._minibatch_size = minibatch_size
+        self._job_type = job_type
+        self._log_loss_steps = log_loss_steps
+        self._max_minibatch_retries = max_minibatch_retries
+        self._metadata = data_reader.metadata
+        self._steps = 0
+        self._callbacks = (
+            model_spec.callbacks() if model_spec.callbacks else []
+        )
+
+    # ---------- public ----------
+
+    def run(self):
+        if self._job_type in (
+            JobType.TRAINING_ONLY,
+            JobType.TRAINING_WITH_EVALUATION,
+        ):
+            self._train_and_evaluate()
+        elif self._job_type == JobType.EVALUATION_ONLY:
+            self._evaluate_only()
+        elif self._job_type == JobType.PREDICTION_ONLY:
+            self._predict_only()
+        else:
+            raise ValueError(f"unknown job type {self._job_type}")
+
+    # ---------- job loops ----------
+
+    def _train_and_evaluate(self):
+        while True:
+            task = self._tds.get_task()
+            if task is None:
+                logger.info("Worker %d: no more tasks", self._worker_id)
+                break
+            if task.type == pb.TRAINING:
+                self._run_task(task, self._process_train_batch)
+                # In local/AllReduce modes the worker is the version source
+                # (the PS plays that role in PS mode): reporting after each
+                # training task drives version-triggered evaluation.
+                self._mc.report_version(self._trainer.get_model_version())
+                # Interleave pending evaluation tasks between training tasks
+                # (reference worker.py:343-349).
+                if self._job_type == JobType.TRAINING_WITH_EVALUATION:
+                    self._drain_eval_tasks()
+            elif task.type == pb.EVALUATION:
+                self._run_task(task, self._process_eval_batch)
+            elif task.type == pb.TRAIN_END_CALLBACK:
+                self._run_train_end_callbacks(task)
+            else:
+                logger.warning("Skipping unexpected task %s", task)
+                self._tds.report_task(task.task_id)
+
+    def _evaluate_only(self):
+        while True:
+            task = self._tds.get_task(pb.EVALUATION)
+            if task is None:
+                break
+            self._run_task(task, self._process_eval_batch)
+
+    def _predict_only(self):
+        processor = self._spec.prediction_outputs_processor
+        while True:
+            task = self._tds.get_task(pb.PREDICTION)
+            if task is None:
+                break
+            self._run_task(
+                task,
+                lambda records, task=task: self._process_predict_batch(
+                    records, processor
+                ),
+            )
+
+    def _drain_eval_tasks(self):
+        while True:
+            task = self._tds.try_get_eval_task()
+            if task is None:
+                return
+            self._run_task(task, self._process_eval_batch)
+
+    # ---------- task/batch processing ----------
+
+    def _run_task(self, task, process_batch):
+        try:
+            for records in self._tds.read_batches(task, self._minibatch_size):
+                self._process_with_retries(process_batch, records)
+            self._tds.report_task(task.task_id)
+        except Exception as e:
+            logger.error(
+                "Task %d failed: %s\n%s",
+                task.task_id,
+                e,
+                traceback.format_exc(),
+            )
+            self._tds.report_task(task.task_id, err_message=str(e))
+
+    def _process_with_retries(self, process_batch, records):
+        """Per-minibatch retry (reference worker.py:165-218): transient
+        failures (PS restart, comm regroup) retry up to the cap; then the
+        whole task is failed back to the master for re-dispatch."""
+        for attempt in range(self._max_minibatch_retries):
+            try:
+                process_batch(records)
+                return
+            except Exception:
+                if attempt == self._max_minibatch_retries - 1:
+                    raise
+                logger.warning(
+                    "Minibatch failed (attempt %d):\n%s",
+                    attempt + 1,
+                    traceback.format_exc(),
+                )
+
+    def _process_train_batch(self, records):
+        features, labels = self._spec.feed(
+            records, Modes.TRAINING, self._metadata
+        )
+        accepted, version, loss = self._trainer.train_minibatch(
+            features, labels
+        )
+        if accepted:
+            self._steps += 1
+            if self._steps % self._log_loss_steps == 0:
+                logger.info(
+                    "Step %d (version %d) loss %.6f",
+                    self._steps,
+                    version,
+                    loss,
+                )
+
+    def _process_eval_batch(self, records):
+        features, labels = self._spec.feed(
+            records, Modes.EVALUATION, self._metadata
+        )
+        outputs = self._trainer.evaluate_minibatch(features)
+        self._mc.report_evaluation_metrics(outputs, labels)
+
+    def _process_predict_batch(self, records, processor):
+        features, _ = self._spec.feed(
+            records, Modes.PREDICTION, self._metadata
+        )
+        outputs = self._trainer.predict_minibatch(features)
+        if processor is not None:
+            processor.process(outputs, self._worker_id)
+
+    def _run_train_end_callbacks(self, task):
+        try:
+            for cb in self._callbacks:
+                on_train_end = getattr(cb, "on_train_end", None)
+                if on_train_end:
+                    on_train_end(self._trainer)
+            self._tds.report_task(task.task_id)
+        except Exception as e:
+            self._tds.report_task(task.task_id, err_message=str(e))
+
+    @property
+    def steps(self):
+        return self._steps
+
+    @property
+    def trainer(self):
+        return self._trainer

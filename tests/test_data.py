@@ -1,0 +1,108 @@
+import numpy as np
+import pytest
+
+from elasticdl_tpu.data import example as ex
+from elasticdl_tpu.data.reader import (
+    CSVDataReader,
+    InMemoryReader,
+    RecordFileReader,
+    create_data_reader,
+)
+from elasticdl_tpu.data.recordfile import (
+    RecordFile,
+    RecordFileWriter,
+    write_records,
+)
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+
+class FakeTask:
+    def __init__(self, shard_name, start, end):
+        self.shard_name, self.start, self.end = shard_name, start, end
+
+
+def test_recordfile_roundtrip_and_range_read(tmp_path):
+    path = str(tmp_path / "a.edlr")
+    records = [f"rec-{i}".encode() for i in range(100)]
+    write_records(path, records)
+    rf = RecordFile(path)
+    assert rf.num_records == 100
+    assert list(rf.read(0, 3)) == records[:3]
+    assert list(rf.read(97, 3)) == records[97:]
+    assert list(rf.read(50, 1)) == [b"rec-50"]
+    with pytest.raises(IndexError):
+        list(rf.read(99, 2))
+    rf.close()
+
+
+def test_recordfile_detects_truncation(tmp_path):
+    path = str(tmp_path / "a.edlr")
+    write_records(path, [b"x" * 100])
+    data = open(path, "rb").read()
+    open(path, "wb").write(data[:-7])
+    with pytest.raises(ValueError, match="corrupt|footer"):
+        RecordFile(path)
+
+
+def test_example_codec_roundtrip():
+    features = {
+        "image": np.random.default_rng(0).random((28, 28)).astype(np.float32),
+        "label": np.int64(7),
+    }
+    back = ex.decode_example(ex.encode_example(features))
+    np.testing.assert_array_equal(back["image"], features["image"])
+    assert back["label"] == 7
+
+
+def test_batch_examples():
+    records = [
+        ex.encode_example({"x": np.full((3,), i, np.float32), "y": np.int64(i)})
+        for i in range(4)
+    ]
+    batch = ex.batch_examples(records)
+    assert batch["x"].shape == (4, 3)
+    np.testing.assert_array_equal(batch["y"], [0, 1, 2, 3])
+
+
+def test_recordfile_reader_with_dispatcher(tmp_path):
+    for name, n in [("s1", 25), ("s2", 10)]:
+        write_records(
+            str(tmp_path / f"{name}.edlr"),
+            [ex.encode_example({"i": np.int64(i)}) for i in range(n)],
+        )
+    reader = RecordFileReader(str(tmp_path))
+    shards = reader.create_shards()
+    assert sorted(v[1] for v in shards.values()) == [10, 25]
+    task_d = TaskDispatcher(shards, records_per_task=10, shuffle=False)
+    seen = []
+    while True:
+        tid, task = task_d.get(0)
+        if task is None:
+            break
+        for record in reader.read_records(task):
+            seen.append((task.shard_name, int(ex.decode_example(record)["i"])))
+        task_d.report(tid, True)
+    assert len(seen) == 35
+    assert len(set(seen)) == 35  # every record exactly once
+
+
+def test_csv_reader(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("a,b\n1,x\n2,y\n3,z\n")
+    reader = CSVDataReader(str(p), with_header=True)
+    assert reader.metadata.column_names == ["a", "b"]
+    shards = reader.create_shards()
+    assert shards[str(p)] == (0, 3)
+    rows = list(reader.read_records(FakeTask(str(p), 1, 3)))
+    assert rows == [("2", "y"), ("3", "z")]
+
+
+def test_in_memory_reader_and_factory(tmp_path):
+    r = create_data_reader([b"a", b"b", b"c"])
+    assert isinstance(r, InMemoryReader)
+    assert list(r.read_records(FakeTask("memory", 1, 3))) == [b"b", b"c"]
+    p = tmp_path / "x.csv"
+    p.write_text("1,2\n")
+    assert isinstance(create_data_reader(str(p)), CSVDataReader)
+    with pytest.raises(ValueError):
+        create_data_reader("wat.xyz")

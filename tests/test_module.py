@@ -1,0 +1,59 @@
+"""Toy model specs for tests (the reference's tests/test_module.py pattern)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.common.evaluation_utils import MeanMetric
+from elasticdl_tpu.common.model_utils import Modes
+from elasticdl_tpu.data.example import batch_examples, encode_example
+from elasticdl_tpu.ops import optimizers
+
+FEATURE_DIM = 4
+TRUE_W = np.array([1.0, -2.0, 3.0, 0.5], dtype=np.float32)
+TRUE_B = 0.25
+
+
+class LinearModel(nn.Module):
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        return nn.Dense(1)(x)
+
+
+def custom_model():
+    return LinearModel()
+
+
+def loss(labels, predictions):
+    return jnp.mean((predictions.reshape(-1) - labels.reshape(-1)) ** 2)
+
+
+def optimizer(lr=0.1):
+    return optimizers.sgd(learning_rate=lr)
+
+
+def feed(records, mode, metadata):
+    batch = batch_examples(records)
+    labels = batch["y"] if mode != Modes.PREDICTION else None
+    return batch["x"], labels
+
+
+def eval_metrics_fn():
+    return {
+        "mse": MeanMetric(
+            lambda outputs, labels: (
+                np.asarray(outputs).reshape(-1) - np.asarray(labels).reshape(-1)
+            )
+            ** 2
+        )
+    }
+
+
+def make_linear_records(n, seed=0):
+    """y = TRUE_W . x + TRUE_B, exactly learnable by LinearModel."""
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(size=(n, FEATURE_DIM)).astype(np.float32)
+    ys = xs @ TRUE_W + TRUE_B
+    return [
+        encode_example({"x": xs[i], "y": np.float32(ys[i])}) for i in range(n)
+    ]
