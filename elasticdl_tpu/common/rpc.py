@@ -43,6 +43,13 @@ MASTER_SERVICE = ServiceSpec(
     },
 )
 
+# Rank-0 worker state broadcast for elastic AllReduce regroups (the Horovod
+# broadcast_variables analog — see elasticdl_tpu/parallel/broadcast.py).
+COLLECTIVE_SERVICE = ServiceSpec(
+    name="elasticdl_tpu.Collective",
+    methods={"pull_model": (pb.PullDenseParametersRequest, pb.Model)},
+)
+
 PSERVER_SERVICE = ServiceSpec(
     name="elasticdl_tpu.Pserver",
     methods={

@@ -92,8 +92,6 @@ class CSVDataReader(AbstractDataReader):
                     continue
                 if idx >= task.end:
                     break
-                if i < skip:
-                    continue
                 yield tuple(row)
 
     def create_shards(self):

@@ -66,16 +66,22 @@ class MembershipManager:
             return self._group_id
 
     def get_comm_rank(self, host):
-        """(rank, world_size, group_id, coordinator_addr). rank -1 means the
-        host is not (yet) in the group — it should keep polling."""
+        """(rank, world_size, group_id, coordinator_addr, coordinator_port).
+        rank -1 means the host is not (yet) in the group — it should keep
+        polling. coordinator_addr is the rank-0 worker's registered
+        "ip:port" service address (state-broadcast pulls go there);
+        coordinator_port is the fixed port for the jax.distributed
+        coordination service on that same machine."""
         with self._lock:
             rank = self._hosts.index(host) if host in self._hosts else -1
-            coordinator = (
-                f"{self._hosts[0]}:{self._coordinator_port}"
-                if self._hosts
-                else ""
+            coordinator = self._hosts[0] if self._hosts else ""
+            return (
+                rank,
+                len(self._hosts),
+                self._group_id,
+                coordinator,
+                self._coordinator_port,
             )
-            return rank, len(self._hosts), self._group_id, coordinator
 
     @property
     def group_id(self):

@@ -89,14 +89,19 @@ class MasterServicer:
     def get_comm_rank(self, request, context):
         if self._membership is None:
             return pb.GetCommRankResponse(rank_id=-1)
-        rank, world, group_id, coordinator = self._membership.get_comm_rank(
-            request.worker_host
-        )
+        (
+            rank,
+            world,
+            group_id,
+            coordinator,
+            coordinator_port,
+        ) = self._membership.get_comm_rank(request.worker_host)
         return pb.GetCommRankResponse(
             rank_id=rank,
             world_size=world,
             rendezvous_id=group_id,
             coordinator_addr=coordinator,
+            rendezvous_port=coordinator_port,
         )
 
     def report_worker_liveness(self, request, context):

@@ -1,0 +1,17 @@
+"""TPU-native parallelism: device meshes, sharded training steps, elastic
+world management, and collective state broadcast.
+
+This package replaces the reference's Horovod/Gloo allreduce stack
+(/root/reference/elasticdl/python/worker/allreduce_trainer.py,
+master/rendezvous_server.py) with jax.sharding meshes + XLA collectives over
+ICI/DCN, and the Horovod broadcast with a gRPC parameter pull from the rank-0
+worker.
+"""
+
+from elasticdl_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    data_sharding,
+    replicated_sharding,
+    pad_batch_to_multiple,
+    shard_batch,
+)

@@ -1,0 +1,87 @@
+"""Rank-0 state broadcast for elastic AllReduce regroups.
+
+Replaces Horovod's `broadcast_variables(root_rank=0)` after a re-rendezvous
+(/root/reference/elasticdl/python/worker/allreduce_trainer.py:150-152): the
+rank-0 worker serves its (variables, opt_state, version) over gRPC; joining
+or regrouping workers pull and overwrite their local state. Pytrees cross
+the wire as position-indexed leaves — every worker runs the same model code,
+so treedefs agree and the receiver unflattens with its own local treedef.
+"""
+
+import threading
+
+import jax
+import numpy as np
+
+from elasticdl_tpu.common import rpc, tensor_utils
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+logger = get_logger("parallel.broadcast")
+
+
+def state_to_model_pb(variables, opt_state, version):
+    model = pb.Model(version=version)
+    for prefix, tree in (("v", variables), ("o", opt_state)):
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+            model.dense_parameters.append(
+                tensor_utils.ndarray_to_tensor_pb(
+                    np.asarray(leaf), f"{prefix}{i}"
+                )
+            )
+    return model
+
+
+def model_pb_to_state(model, variables_treedef, opt_treedef):
+    v_leaves, o_leaves = {}, {}
+    for t in model.dense_parameters:
+        arr = tensor_utils.tensor_pb_to_ndarray(t)
+        (v_leaves if t.name[0] == "v" else o_leaves)[int(t.name[1:])] = arr
+    variables = jax.tree_util.tree_unflatten(
+        variables_treedef, [v_leaves[i] for i in range(len(v_leaves))]
+    )
+    opt_state = jax.tree_util.tree_unflatten(
+        opt_treedef, [o_leaves[i] for i in range(len(o_leaves))]
+    )
+    return variables, opt_state, model.version
+
+
+class BroadcastServicer:
+    """Serves the owning trainer's current state. `provider` returns
+    (variables, opt_state, version) or None while uninitialized."""
+
+    def __init__(self, provider):
+        self._provider = provider
+
+    def pull_model(self, request, context):
+        state = self._provider()
+        if state is None:
+            return pb.Model(version=-1)
+        return state_to_model_pb(*state)
+
+
+class BroadcastServer:
+    def __init__(self, provider, port=0):
+        self._server, self.port = rpc.serve(
+            BroadcastServicer(provider), rpc.COLLECTIVE_SERVICE, port=port
+        )
+        logger.info("Broadcast server on port %d", self.port)
+
+    def stop(self):
+        self._server.stop(0)
+
+
+def pull_state(coordinator_addr, variables_treedef, opt_treedef, timeout=30):
+    """Pull rank-0 state. Returns (variables, opt_state, version) or None if
+    the coordinator has no state yet."""
+    channel = rpc.build_channel(coordinator_addr)
+    try:
+        stub = rpc.Stub(channel, rpc.COLLECTIVE_SERVICE)
+        model = stub.pull_model(
+            pb.PullDenseParametersRequest(), timeout=timeout
+        )
+        if model.version < 0:
+            return None
+        return model_pb_to_state(model, variables_treedef, opt_treedef)
+    finally:
+        channel.close()

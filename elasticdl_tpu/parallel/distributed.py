@@ -1,0 +1,61 @@
+"""jax.distributed lifecycle for elastic multi-host worlds.
+
+The reference re-initializes Horovod whenever the master bumps the
+rendezvous id (/root/reference/elasticdl/python/worker/
+allreduce_trainer.py:46-75: hvd.shutdown() + hvd.init()). The TPU analog:
+tear down and re-create the JAX coordination service connection with the new
+(coordinator, world_size, rank) triple, after which jax.devices() shows the
+new global device set and freshly-built meshes span the new world.
+
+Single-process deployments (tests, LOCAL strategy, one TPU host) never call
+initialize — the local platform is the world.
+"""
+
+import jax
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("parallel.distributed")
+
+_current = {"coordinator": None, "world": 0, "rank": -1, "live": False}
+
+
+def ensure_world(coordinator_addr, world_size, rank):
+    """(Re)join the distributed world described by the triple. No-ops when
+    already a member of exactly this world. world_size == 1 tears down any
+    previous multi-host state and runs single-process."""
+    same = (
+        _current["live"]
+        and _current["coordinator"] == coordinator_addr
+        and _current["world"] == world_size
+        and _current["rank"] == rank
+    )
+    if same:
+        return
+    if _current["live"]:
+        logger.info("Leaving distributed world %s", _current)
+        jax.distributed.shutdown()
+        _current["live"] = False
+    if world_size <= 1:
+        _current.update(coordinator=None, world=1, rank=0)
+        return
+    logger.info(
+        "Joining world coordinator=%s size=%d rank=%d",
+        coordinator_addr,
+        world_size,
+        rank,
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_addr,
+        num_processes=world_size,
+        process_id=rank,
+    )
+    _current.update(
+        coordinator=coordinator_addr, world=world_size, rank=rank, live=True
+    )
+
+
+def leave_world():
+    if _current["live"]:
+        jax.distributed.shutdown()
+        _current["live"] = False

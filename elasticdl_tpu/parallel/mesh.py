@@ -1,0 +1,98 @@
+"""Device mesh construction and batch sharding helpers.
+
+The reference's allreduce path gets its topology from Horovod's Gloo ring
+(/root/reference/elasticdl/python/worker/allreduce_trainer.py:77-83). The
+TPU-native equivalent is a named `jax.sharding.Mesh`: data parallelism is the
+"data" axis, tensor/model parallelism "model", sequence/context parallelism
+"seq". XLA lowers psum/all_gather over the mesh to ICI collectives on real
+hardware; nothing here is CPU/TPU specific.
+"""
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+def make_mesh(axis_sizes=None, devices=None) -> Mesh:
+    """Build a Mesh over `devices` (default: all visible, which under
+    jax.distributed is the *global* device set across hosts).
+
+    axis_sizes: ordered {axis_name: size} dict; a single size of -1 (or a
+    missing remainder) absorbs all remaining devices. Default: 1-D data mesh.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    if axis_sizes is None:
+        axis_sizes = {DATA_AXIS: len(devices)}
+    names = tuple(axis_sizes)
+    sizes = list(axis_sizes.values())
+    n_fill = sizes.count(-1)
+    if n_fill > 1:
+        raise ValueError("at most one axis may have size -1")
+    if n_fill == 1:
+        known = math.prod(s for s in sizes if s != -1)
+        if len(devices) % known:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by fixed axes {known}"
+            )
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = math.prod(sizes)
+    if total > len(devices):
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))} wants {total} devices, "
+            f"only {len(devices)} visible"
+        )
+    return Mesh(devices[:total].reshape(sizes), axis_names=names)
+
+
+def data_sharding(mesh: Mesh, axis=DATA_AXIS) -> NamedSharding:
+    """Leading-dim batch sharding over the data axis."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_batch_to_multiple(batch, multiple):
+    """Pad a numpy batch pytree's leading dim up to a multiple by cyclic
+    repetition. Returns (padded_batch, real_n). The training step slices
+    outputs back to real_n before the loss so padding rows never contribute
+    gradient signal.
+    """
+    leaves = jax.tree_util.tree_leaves(batch)
+    if not leaves:
+        return batch, 0
+    real_n = leaves[0].shape[0]
+    padded_n = -(-real_n // multiple) * multiple
+    if padded_n == real_n:
+        return batch, real_n
+    idx = np.arange(padded_n) % real_n
+    padded = jax.tree_util.tree_map(
+        lambda x: np.take(x, idx, axis=0), batch
+    )
+    return padded, real_n
+
+
+def shard_batch(batch, mesh: Mesh, axis=DATA_AXIS):
+    """Place a host batch onto the mesh, sharded along the data axis.
+
+    Single-host: plain device_put. Multi-host (jax.process_count() > 1): each
+    process holds its local slice of the global batch and contributes it via
+    make_array_from_process_local_data — the global array's leading dim is
+    world_batch = local_batch * num_processes.
+    """
+    sharding = data_sharding(mesh, axis)
+    if jax.process_count() > 1:
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(sharding, x),
+            batch,
+        )
+    return jax.device_put(batch, sharding)

@@ -14,6 +14,17 @@ class MasterClient:
         self._worker_id = worker_id
         self._worker_host = worker_host
 
+    @property
+    def worker_host(self):
+        """The "ip:port" address this worker registers with the master; the
+        port is the worker's Collective (broadcast) service port, bound after
+        construction, so trainers update this before first registration."""
+        return self._worker_host
+
+    @worker_host.setter
+    def worker_host(self, host):
+        self._worker_host = host
+
     def get_task(self, task_type=pb.TRAINING):
         return self._stub.get_task(
             pb.GetTaskRequest(
