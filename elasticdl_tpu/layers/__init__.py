@@ -1,0 +1,6 @@
+"""Model-facing layers backed by the distributed runtime."""
+
+from elasticdl_tpu.layers.embedding import (  # noqa: F401
+    EMBEDDING_COLLECTION,
+    DistributedEmbedding,
+)

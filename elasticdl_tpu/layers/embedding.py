@@ -1,0 +1,92 @@
+"""PS-resident embedding layer — the TPU-first redesign of the reference's
+`elasticdl.layers.Embedding` + EmbeddingDelegate
+(/root/reference/elasticdl/python/elasticdl/layers/embedding.py:20-163,
+python/elasticdl/embedding_delegate.py:26-310).
+
+The reference RPCs the parameter server *mid-forward-pass* through a
+tf.py_function and tape-watches the fetched rows so backprop yields sparse
+grads. Under XLA that host round-trip would sit inside the compiled step and
+stall the TPU, so the design is split instead:
+
+  OUTSIDE jit (ps_trainer):  ids -> unique -> PSClient.pull_embedding_vectors
+                             -> per-position rows [n_positions, dim]
+  INSIDE jit (this layer):   rows arrive via the `edl_embedding` flax
+                             collection; the layer reshapes/combines them —
+                             pure gathers and reductions XLA fuses into the
+                             surrounding graph.
+
+Gradients: the trainer differentiates the loss wrt the provided collection,
+giving per-position row grads, deduplicates them by id
+(tensor_utils.deduplicate_indexed_slices) and pushes IndexedSlices to the PS
+— the same wire contract as the reference, with the tape trick replaced by
+explicit differentiation wrt an input.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Collection name under which the PS trainer provides looked-up rows.
+EMBEDDING_COLLECTION = "edl_embedding"
+
+
+class DistributedEmbedding(nn.Module):
+    """Embedding whose table lives in the parameter server, not in params.
+
+    table_name: PS table key (shared across workers).
+    dim: embedding dimension.
+    combiner: None -> return per-id embeddings [*ids.shape, dim];
+              "sum" | "mean" | "sqrtn" -> reduce the LAST id axis, the
+              multivalent-feature combiners of the reference layer
+              (embedding.py:20-163).
+
+    In LOCAL/AllReduce strategies (no PS), the layer degrades to an ordinary
+    trainable table of `vocab_size` rows held in params — set vocab_size for
+    that; under the PS strategy the collection entry overrides it.
+    """
+
+    table_name: str
+    dim: int
+    combiner: str = None
+    vocab_size: int = 0
+
+    @nn.compact
+    def __call__(self, ids):
+        ids = jnp.asarray(ids)
+        n_positions = 1
+        for s in ids.shape:
+            n_positions *= s
+
+        if self.vocab_size:
+            # Local/AllReduce fallback: an ordinary trainable table.
+            table = self.param(
+                "table",
+                nn.initializers.uniform(scale=0.05),
+                (self.vocab_size, self.dim),
+            )
+            batch_embeddings = jnp.take(
+                table, ids.astype(jnp.int32), axis=0
+            )
+        else:
+            # PS strategy: per-position rows provided by the trainer. At
+            # model.init time the collection is mutable and the zeros
+            # init_fn runs (shapes flow, values don't matter); at apply
+            # time self.variable returns the trainer-provided rows.
+            rows = self.variable(
+                EMBEDDING_COLLECTION,
+                self.table_name,
+                lambda: jnp.zeros((n_positions, self.dim), jnp.float32),
+            )
+            batch_embeddings = rows.value.reshape(ids.shape + (self.dim,))
+
+        if self.combiner is None:
+            return batch_embeddings
+        if self.combiner == "sum":
+            return jnp.sum(batch_embeddings, axis=-2)
+        if self.combiner == "mean":
+            return jnp.mean(batch_embeddings, axis=-2)
+        if self.combiner == "sqrtn":
+            n = batch_embeddings.shape[-2]
+            return jnp.sum(batch_embeddings, axis=-2) / jnp.sqrt(
+                jnp.asarray(n, batch_embeddings.dtype)
+            )
+        raise ValueError(f"unknown combiner {self.combiner!r}")

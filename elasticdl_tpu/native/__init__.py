@@ -1,0 +1,99 @@
+"""ctypes bindings for the native optimizer/table kernels.
+
+The reference reaches its C++ kernels through Go's cgo
+(/root/reference/elasticdl/go/pkg/kernel/kernel.go:16-18); here the Python
+parameter server calls the shared library directly via ctypes — no binding
+codegen, no copy: numpy arrays pass as raw pointers.
+
+`lib()` lazily builds libedl_kernels.so with the package Makefile on first
+use (g++ is in the base image), so a fresh checkout needs no explicit build
+step; set EDL_NO_NATIVE=1 to force the pure-numpy fallbacks in
+elasticdl_tpu/ps/optimizer.py.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("native")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libedl_kernels.so")
+_lock = threading.Lock()
+_lib = None
+
+
+def _f32p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _i64p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _declare(lib):
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i64 = ctypes.c_int64
+    f32 = ctypes.c_float
+    sigs = {
+        "edl_sgd": [f32p, f32p, f32, i64],
+        "edl_momentum": [f32p, f32p, f32p, f32, f32, ctypes.c_int, i64],
+        "edl_adam": [f32p, f32p, f32p, f32p, f32p, f32, i64, f32, f32, f32,
+                     i64],
+        "edl_adagrad": [f32p, f32p, f32p, f32, f32, i64],
+        "edl_sgd_indexed": [f32p, i64p, i64, i64, f32p, f32],
+        "edl_momentum_indexed": [f32p, i64p, i64, i64, f32p, f32p, f32, f32,
+                                 ctypes.c_int],
+        "edl_adam_indexed": [f32p, i64p, i64, i64, f32p, f32p, f32p, f32p,
+                             f32, i64, f32, f32, f32],
+        "edl_adagrad_indexed": [f32p, i64p, i64, i64, f32p, f32p, f32, f32],
+        "edl_gather_rows": [f32p, i64p, i64, i64, f32p],
+        "edl_scatter_rows": [f32p, i64p, i64, i64, f32p],
+        "edl_uniform_init": [f32p, i64, f32, f32, ctypes.c_uint64],
+    }
+    for name, argtypes in sigs.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = None
+    return lib
+
+
+def build():
+    subprocess.run(
+        ["make", "-s", "-C", _HERE], check=True, capture_output=True
+    )
+
+
+def lib():
+    """The loaded shared library, building it on first call. Returns None
+    when natives are disabled or the toolchain is unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib or None
+    with _lock:
+        if _lib is not None:
+            return _lib or None
+        if os.environ.get("EDL_NO_NATIVE"):
+            _lib = False
+            return None
+        try:
+            if not os.path.exists(_SO) or os.path.getmtime(
+                _SO
+            ) < os.path.getmtime(os.path.join(_HERE, "kernels.cc")):
+                build()
+            _lib = _declare(ctypes.CDLL(_SO))
+            logger.info("Loaded native kernels from %s", _SO)
+        except Exception as e:
+            logger.warning(
+                "Native kernels unavailable (%s); numpy fallbacks in use", e
+            )
+            _lib = False
+    return _lib or None
+
+
+def available():
+    return lib() is not None

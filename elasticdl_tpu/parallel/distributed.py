@@ -17,18 +17,29 @@ from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("parallel.distributed")
 
-_current = {"coordinator": None, "world": 0, "rank": -1, "live": False}
+_current = {
+    "coordinator": None,
+    "world": 0,
+    "rank": -1,
+    "epoch": -1,
+    "live": False,
+}
 
 
-def ensure_world(coordinator_addr, world_size, rank):
-    """(Re)join the distributed world described by the triple. No-ops when
-    already a member of exactly this world. world_size == 1 tears down any
-    previous multi-host state and runs single-process."""
+def ensure_world(coordinator_addr, world_size, rank, epoch=None):
+    """(Re)join the distributed world described by the triple. No-ops only
+    when already a member of this world AT THIS membership epoch — the epoch
+    matters because a survivor's (coordinator, world, rank) can be unchanged
+    across a swap (B dies, C joins) while the coordination service still
+    needs a full re-init for the newcomer to rendezvous. world_size == 1
+    tears down any previous multi-host state and runs single-process."""
     same = (
         _current["live"]
         and _current["coordinator"] == coordinator_addr
         and _current["world"] == world_size
         and _current["rank"] == rank
+        and epoch is not None
+        and _current["epoch"] == epoch
     )
     if same:
         return
@@ -37,13 +48,14 @@ def ensure_world(coordinator_addr, world_size, rank):
         jax.distributed.shutdown()
         _current["live"] = False
     if world_size <= 1:
-        _current.update(coordinator=None, world=1, rank=0)
+        _current.update(coordinator=None, world=1, rank=0, epoch=epoch)
         return
     logger.info(
-        "Joining world coordinator=%s size=%d rank=%d",
+        "Joining world coordinator=%s size=%d rank=%d epoch=%s",
         coordinator_addr,
         world_size,
         rank,
+        epoch,
     )
     jax.distributed.initialize(
         coordinator_address=coordinator_addr,
@@ -51,7 +63,11 @@ def ensure_world(coordinator_addr, world_size, rank):
         process_id=rank,
     )
     _current.update(
-        coordinator=coordinator_addr, world=world_size, rank=rank, live=True
+        coordinator=coordinator_addr,
+        world=world_size,
+        rank=rank,
+        epoch=epoch,
+        live=True,
     )
 
 

@@ -1,0 +1,10 @@
+"""Parameter-server strategy: host-resident sharded model store.
+
+The reference implements this twice — a production Go gRPC server with C++
+Eigen kernels (/root/reference/elasticdl/go/) and a Python twin
+(elasticdl/python/ps/). Here there is ONE implementation: a Python gRPC
+control surface over slab-backed numpy state whose hot math (optimizer
+updates, embedding gather/scatter, lazy init) runs in the native C++ library
+(elasticdl_tpu/native/kernels.cc) via ctypes — the same split as the
+reference's Go-control/C++-math, without the duplicate servicer.
+"""

@@ -1,0 +1,175 @@
+"""Slab-backed embedding table with lazy per-id initialization.
+
+Reference counterpart: map[int64]*Tensor with an RWMutex and lazy uniform
+[-0.05, 0.05] row init (/root/reference/elasticdl/go/pkg/common/
+embedding_table.go:22-88) and the Python dict twin
+(elasticdl/python/ps/embedding_table.py:23-136). Redesign: rows live in ONE
+contiguous [capacity, dim] float32 slab that doubles on growth, with an
+id -> row-index dict on the side. That layout is what lets the native
+optimizer kernels update k sparse rows in a single C call, and what makes
+lookups a single gather instead of k dict hits.
+
+Slot tables (Adam m/v, momentum velocity, ...) are companion slabs allocated
+by the optimizer with the SAME row mapping, so one row-index array drives the
+parameter and all its slots.
+"""
+
+import threading
+
+import numpy as np
+
+from elasticdl_tpu import native
+
+DEFAULT_CAPACITY = 1024
+INIT_LOW, INIT_HIGH = -0.05, 0.05
+
+
+class EmbeddingTable:
+    def __init__(self, name, dim, initializer="uniform", dtype=np.float32,
+                 capacity=DEFAULT_CAPACITY, seed=0):
+        self.name = name
+        self.dim = int(dim)
+        self.initializer = initializer
+        self.dtype = np.dtype(dtype)
+        self._lock = threading.RLock()
+        self._slab = np.zeros((capacity, self.dim), dtype=self.dtype)
+        self._id_to_row = {}
+        self._seed = seed
+        # Companion slabs (optimizer slots) registered via create_slot;
+        # grown in lockstep with the parameter slab.
+        self._slots = {}
+        self._slot_init_val = {}
+
+    # ---------- row management ----------
+
+    def __len__(self):
+        return len(self._id_to_row)
+
+    @property
+    def ids(self):
+        with self._lock:
+            return np.fromiter(
+                self._id_to_row.keys(), dtype=np.int64, count=len(self._id_to_row)
+            )
+
+    def _grow(self, min_capacity):
+        capacity = self._slab.shape[0]
+        while capacity < min_capacity:
+            capacity *= 2
+        grown = np.zeros((capacity, self.dim), dtype=self.dtype)
+        grown[: self._slab.shape[0]] = self._slab
+        self._slab = grown
+        for slot_name, slab in self._slots.items():
+            g = np.full((capacity, self.dim), self._slot_init_val[slot_name],
+                        dtype=self.dtype)
+            g[: slab.shape[0]] = slab
+            self._slots[slot_name] = g
+
+    def _init_row(self, row):
+        dst = self._slab[row]
+        if self.initializer == "zeros":
+            dst[:] = 0.0
+            return
+        # Deterministic per-row seed so a resharded restore that re-inits
+        # unseen ids stays reproducible.
+        lib = native.lib()
+        seed = (self._seed * 0x9E3779B1 + row + 1) & 0xFFFFFFFFFFFFFFFF
+        if lib is not None and self.dtype == np.float32:
+            lib.edl_uniform_init(
+                dst.ctypes.data_as(native.ctypes.POINTER(
+                    native.ctypes.c_float)),
+                self.dim, INIT_LOW, INIT_HIGH, seed,
+            )
+        else:
+            rng = np.random.default_rng(seed)
+            dst[:] = rng.uniform(INIT_LOW, INIT_HIGH, self.dim).astype(
+                self.dtype
+            )
+
+    def rows_for_ids(self, ids, create_missing=True):
+        """id array -> row-index array, lazily materializing unseen ids (the
+        'lazy init on first lookup' semantics)."""
+        ids = np.asarray(ids, dtype=np.int64)
+        rows = np.empty(len(ids), dtype=np.int64)
+        with self._lock:
+            for i, id_ in enumerate(ids):
+                row = self._id_to_row.get(int(id_))
+                if row is None:
+                    if not create_missing:
+                        rows[i] = -1
+                        continue
+                    row = len(self._id_to_row)
+                    if row >= self._slab.shape[0]:
+                        self._grow(row + 1)
+                    self._id_to_row[int(id_)] = row
+                    self._init_row(row)
+                rows[i] = row
+        return rows
+
+    # ---------- lookup / assign ----------
+
+    def lookup(self, ids):
+        """[k] ids -> [k, dim] values; unseen ids are lazily initialized."""
+        rows = self.rows_for_ids(ids)
+        with self._lock:
+            lib = native.lib()
+            if lib is not None and self.dtype == np.float32:
+                out = np.empty((len(rows), self.dim), dtype=np.float32)
+                lib.edl_gather_rows(
+                    native._f32p(self._slab), native._i64p(rows),
+                    len(rows), self.dim, native._f32p(out),
+                )
+                return out
+            return self._slab[rows].copy()
+
+    def assign(self, ids, values):
+        values = np.ascontiguousarray(values, dtype=self.dtype)
+        rows = self.rows_for_ids(ids)
+        with self._lock:
+            lib = native.lib()
+            if lib is not None and self.dtype == np.float32:
+                lib.edl_scatter_rows(
+                    native._f32p(self._slab), native._i64p(rows),
+                    len(rows), self.dim, native._f32p(values),
+                )
+            else:
+                self._slab[rows] = values
+
+    # ---------- optimizer slots ----------
+
+    def create_slot(self, slot_name, init_value=0.0):
+        with self._lock:
+            if slot_name not in self._slots:
+                self._slot_init_val[slot_name] = init_value
+                self._slots[slot_name] = np.full(
+                    self._slab.shape, init_value, dtype=self.dtype
+                )
+            return self._slots[slot_name]
+
+    def slot_slab(self, slot_name):
+        return self._slots[slot_name]
+
+    @property
+    def slab(self):
+        return self._slab
+
+    @property
+    def lock(self):
+        """RLock guarding the slab: callers that hold row indices across a
+        kernel call take this so a concurrent grow can't swap the buffer
+        out from under the raw pointers."""
+        return self._lock
+
+    # ---------- checkpoint export/import ----------
+
+    def export_rows(self):
+        """(ids, values) for every materialized id, row-aligned."""
+        with self._lock:
+            ids = self.ids
+            rows = np.fromiter(
+                self._id_to_row.values(), dtype=np.int64, count=len(ids)
+            )
+            return ids, self._slab[rows].copy()
+
+    def import_rows(self, ids, values):
+        self.assign(ids, values)

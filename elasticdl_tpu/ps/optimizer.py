@@ -1,0 +1,236 @@
+"""PS-side optimizer: applies gradients to the host-resident store through
+the native C++ kernels.
+
+Reference counterparts: the Go optimizer interface with its
+Dense/Sparse/Indexed kernel triples (/root/reference/elasticdl/go/pkg/ps/
+optimizer.go:43-73,329-390) and the Python OptimizerWrapper that injected
+temp tf.Variables into Keras optimizer slots for embedding rows
+(elasticdl/python/ps/optimizer_wrapper.py:70-351). The slab design makes the
+wrapper dance unnecessary: optimizer slots ARE companion slabs with the same
+row mapping, so sparse updates call one indexed kernel — no variable
+materialization, no slot injection, no writeback.
+
+A thread-safe LR modulator supports the staleness-based learning-rate
+scaling of async SGD (reference python/ps/learning_rate_modulator.py:17-73).
+"""
+
+import ctypes
+import threading
+
+import numpy as np
+
+from elasticdl_tpu import native
+from elasticdl_tpu.ops.optimizers import OptimizerSpec
+
+_NULL_F32 = ctypes.POINTER(ctypes.c_float)()
+
+
+class LearningRateModulator:
+    """Per-call LR multiplier, set by the servicer thread handling a push
+    (thread-local, so concurrent pushes with different staleness don't race).
+    """
+
+    def __init__(self):
+        self._local = threading.local()
+
+    def set_multiplier(self, m):
+        self._local.multiplier = m
+
+    def get(self, base_lr):
+        return base_lr * getattr(self._local, "multiplier", 1.0)
+
+
+class PSOptimizer:
+    """Applies dense and sparse (indexed) gradients in place.
+
+    Dense state lives in `self._dense_slots[param_name][slot]` numpy arrays;
+    sparse state lives as companion slabs inside each EmbeddingTable.
+    """
+
+    # slot name -> initial value, per optimizer family
+    _SLOTS = {
+        "sgd": {},
+        "momentum": {"velocity": 0.0},
+        "adam": {"m": 0.0, "v": 0.0},
+        "adagrad": {"accumulator": None},  # filled from hyperparam
+    }
+
+    def __init__(self, spec: OptimizerSpec):
+        self._spec = spec
+        self._h = spec.hyperparams
+        self._name = spec.name
+        self._dense_slots = {}
+        self._step = 0  # global step for Adam bias correction
+        self._step_lock = threading.Lock()
+        self.lr_modulator = LearningRateModulator()
+        slots = dict(self._SLOTS[self._name])
+        if self._name == "adagrad":
+            slots["accumulator"] = self._h["initial_accumulator_value"]
+        if self._name == "adam" and self._h["amsgrad"]:
+            slots["max_sq"] = 0.0
+        self._slot_inits = slots
+
+    @property
+    def spec(self):
+        return self._spec
+
+    def _next_step(self):
+        with self._step_lock:
+            self._step += 1
+            return self._step
+
+    def _lr(self):
+        return self.lr_modulator.get(self._h["learning_rate"])
+
+    # ---------- dense ----------
+
+    def _dense_slot(self, name, slot, shape):
+        slots = self._dense_slots.setdefault(name, {})
+        if slot not in slots:
+            slots[slot] = np.full(
+                shape, self._slot_inits[slot], dtype=np.float32
+            )
+        return slots[slot]
+
+    def apply_dense(self, name, param, grad):
+        """In-place update of `param` (numpy float32) with `grad`."""
+        grad = np.ascontiguousarray(grad, dtype=np.float32)
+        if grad.shape != param.shape:
+            raise ValueError(
+                f"gradient shape {grad.shape} != param shape "
+                f"{param.shape} for {name!r}"
+            )
+        lr = self._lr()
+        n = param.size
+        lib = native.lib()
+        if lib is None:
+            return self._apply_dense_numpy(name, param, grad, lr)
+        g, p = native._f32p(grad), native._f32p(param)
+        if self._name == "sgd":
+            lib.edl_sgd(g, p, lr, n)
+        elif self._name == "momentum":
+            vel = self._dense_slot(name, "velocity", param.shape)
+            lib.edl_momentum(
+                g, p, native._f32p(vel), lr, self._h["momentum"],
+                int(self._h["nesterov"]), n,
+            )
+        elif self._name == "adam":
+            m = self._dense_slot(name, "m", param.shape)
+            v = self._dense_slot(name, "v", param.shape)
+            ms = (
+                native._f32p(self._dense_slot(name, "max_sq", param.shape))
+                if self._h["amsgrad"] else _NULL_F32
+            )
+            lib.edl_adam(
+                g, p, native._f32p(m), native._f32p(v), ms, lr,
+                self._next_step(), self._h["beta_1"], self._h["beta_2"],
+                self._h["epsilon"], n,
+            )
+        elif self._name == "adagrad":
+            accum = self._dense_slot(name, "accumulator", param.shape)
+            lib.edl_adagrad(
+                g, p, native._f32p(accum), lr, self._h["epsilon"], n
+            )
+        else:
+            raise AssertionError(self._name)
+
+    # ---------- sparse (embedding tables) ----------
+
+    def apply_sparse(self, table, ids, grads):
+        """Indexed update of embedding `table` rows for `ids` with
+        [len(ids), dim] `grads`. Ids are deduplicated by the caller
+        (ps client merges before pushing; servicer merges in sync mode)."""
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        ids = np.asarray(ids, dtype=np.int64)
+        lr = self._lr()
+        lib = native.lib()
+        with table.lock:
+            rows = table.rows_for_ids(ids)
+            if lib is None:
+                return self._apply_sparse_numpy(table, rows, grads, lr)
+            k, dim = grads.shape
+            g, r = native._f32p(grads), native._i64p(rows)
+            slab = native._f32p(table.slab)
+            if self._name == "sgd":
+                lib.edl_sgd_indexed(g, r, k, dim, slab, lr)
+            elif self._name == "momentum":
+                vel = table.create_slot("velocity", 0.0)
+                lib.edl_momentum_indexed(
+                    g, r, k, dim, slab, native._f32p(vel), lr,
+                    self._h["momentum"], int(self._h["nesterov"]),
+                )
+            elif self._name == "adam":
+                m = table.create_slot("m", 0.0)
+                v = table.create_slot("v", 0.0)
+                ms = (
+                    native._f32p(table.create_slot("max_sq", 0.0))
+                    if self._h["amsgrad"] else _NULL_F32
+                )
+                lib.edl_adam_indexed(
+                    g, r, k, dim, slab, native._f32p(m), native._f32p(v),
+                    ms, lr, self._next_step(), self._h["beta_1"],
+                    self._h["beta_2"], self._h["epsilon"],
+                )
+            elif self._name == "adagrad":
+                accum = table.create_slot(
+                    "accumulator", self._h["initial_accumulator_value"]
+                )
+                lib.edl_adagrad_indexed(
+                    g, r, k, dim, slab, native._f32p(accum), lr,
+                    self._h["epsilon"],
+                )
+            else:
+                raise AssertionError(self._name)
+
+    # ---------- numpy fallbacks (EDL_NO_NATIVE=1 or no toolchain) ----------
+
+    def _apply_dense_numpy(self, name, param, grad, lr):
+        step = self._next_step() if self._name == "adam" else 0
+        self._numpy_rule(
+            param.reshape(-1), grad.reshape(-1), lr, step,
+            lambda slot, init: self._dense_slot(
+                name, slot, param.shape
+            ).reshape(-1),
+        )
+
+    def _apply_sparse_numpy(self, table, rows, grads, lr):
+        # One global Adam step per push, matching the native indexed kernel.
+        step = self._next_step() if self._name == "adam" else 0
+        for j, row in enumerate(rows):
+            self._numpy_rule(
+                table.slab[row], grads[j], lr, step,
+                lambda slot, init: table.create_slot(slot, init)[row],
+            )
+
+    def _numpy_rule(self, p, g, lr, step, slot_of):
+        h = self._h
+        if self._name == "sgd":
+            p -= lr * g
+        elif self._name == "momentum":
+            vel = slot_of("velocity", 0.0)
+            vel *= h["momentum"]
+            vel += g
+            p -= lr * (g + h["momentum"] * vel) if h["nesterov"] else lr * vel
+        elif self._name == "adam":
+            m, v = slot_of("m", 0.0), slot_of("v", 0.0)
+            m *= h["beta_1"]
+            m += (1 - h["beta_1"]) * g
+            v *= h["beta_2"]
+            v += (1 - h["beta_2"]) * g * g
+            lr_t = lr * np.sqrt(1 - h["beta_2"] ** step) / (
+                1 - h["beta_1"] ** step
+            )
+            if h["amsgrad"]:
+                ms = slot_of("max_sq", 0.0)
+                np.maximum(ms, v, out=ms)
+                p -= lr_t * m / (np.sqrt(ms) + h["epsilon"])
+            else:
+                p -= lr_t * m / (np.sqrt(v) + h["epsilon"])
+        elif self._name == "adagrad":
+            accum = slot_of(
+                "accumulator", h["initial_accumulator_value"]
+            )
+            accum += g * g
+            p -= lr * g / (np.sqrt(accum) + h["epsilon"])
+        else:
+            raise AssertionError(self._name)

@@ -1,0 +1,103 @@
+"""Parameter-server process bootstrap.
+
+Reference counterparts: the Go PS main (/root/reference/elasticdl/go/cmd/
+elasticdl_ps/main.go:27-74) and the Python twin bootstrap
+(elasticdl/python/ps/parameter_server.py:34-163): build store + optimizer +
+servicer, optionally restore from a checkpoint (resharding to this shard's
+id/count), serve, and exit when the master goes away.
+"""
+
+import threading
+import time
+
+from elasticdl_tpu.common import rpc
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.ops.optimizers import OptimizerSpec
+from elasticdl_tpu.ps import checkpoint as ckpt
+from elasticdl_tpu.ps.optimizer import PSOptimizer
+from elasticdl_tpu.ps.parameters import Parameters
+from elasticdl_tpu.ps.servicer import PserverServicer
+
+logger = get_logger("ps.parameter_server")
+
+
+class ParameterServer:
+    def __init__(
+        self,
+        ps_id,
+        num_ps,
+        port=0,
+        optimizer_spec=None,
+        use_async=True,
+        grads_to_wait=1,
+        sync_version_tolerance=0,
+        lr_staleness_modulation=False,
+        checkpoint_dir=None,
+        checkpoint_steps=0,
+        keep_checkpoint_max=3,
+        checkpoint_dir_for_init=None,
+        master_client=None,
+    ):
+        self.ps_id = ps_id
+        self.num_ps = num_ps
+        self.parameters = Parameters()
+        self.optimizer = PSOptimizer(
+            optimizer_spec or OptimizerSpec("sgd")
+        )
+        saver = None
+        if checkpoint_dir and checkpoint_steps:
+            saver = ckpt.CheckpointSaver(
+                checkpoint_dir, ps_id, num_ps, keep_checkpoint_max
+            )
+        if checkpoint_dir_for_init:
+            version = ckpt.latest_complete_version(checkpoint_dir_for_init)
+            if version is None:
+                raise ValueError(
+                    f"no complete checkpoint under {checkpoint_dir_for_init}"
+                )
+            ckpt.restore_shard(
+                checkpoint_dir_for_init,
+                version,
+                self.parameters,
+                ps_id,
+                num_ps,
+            )
+        self.servicer = PserverServicer(
+            self.parameters,
+            self.optimizer,
+            use_async=use_async,
+            grads_to_wait=grads_to_wait,
+            sync_version_tolerance=sync_version_tolerance,
+            lr_staleness_modulation=lr_staleness_modulation,
+            checkpoint_saver=saver,
+            checkpoint_steps=checkpoint_steps,
+            master_client=master_client,
+        )
+        self._server, self.port = rpc.serve(
+            self.servicer, rpc.PSERVER_SERVICE, port=port
+        )
+        logger.info("PS %d/%d serving on port %d", ps_id, num_ps, self.port)
+        self._stop_event = threading.Event()
+
+    @property
+    def addr(self):
+        return f"localhost:{self.port}"
+
+    def wait(self, master_liveness_check=None, poll_seconds=30):
+        """Block until stopped; with a liveness callable, exit when the
+        master is gone (reference PS watches the master pod,
+        go/cmd/elasticdl_ps/main.go:48-74)."""
+        while not self._stop_event.is_set():
+            if master_liveness_check is not None:
+                try:
+                    alive = master_liveness_check()
+                except Exception:
+                    alive = False
+                if not alive:
+                    logger.info("Master gone; PS %d exiting", self.ps_id)
+                    break
+            self._stop_event.wait(poll_seconds)
+
+    def stop(self):
+        self._stop_event.set()
+        self._server.stop(0)

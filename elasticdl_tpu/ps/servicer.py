@@ -1,0 +1,224 @@
+"""The Pserver gRPC servicer: async/sync gradient application over the store.
+
+Reference counterparts: Go server (/root/reference/elasticdl/go/pkg/ps/
+server.go:144-244) and the Python twin (elasticdl/python/ps/
+servicer.py:33-288). Semantics kept:
+
+- async mode: every push applies immediately; stale pushes (worker version <
+  PS version) get their LR scaled down by the staleness when
+  lr_staleness_modulation is on (Python twin servicer.py:148-154).
+- sync mode: pushes buffer until `grads_to_wait` arrive, then dense grads
+  average / sparse grads merge and apply once; pushes older than
+  `sync_version_tolerance` are rejected (accepted=False → worker re-pulls
+  and recomputes, servicer.py:166-236).
+- every apply bumps `version`; every `checkpoint_steps` versions the shard
+  checkpoints itself; every `report_version_steps` it reports to the master
+  (the version-triggered-evaluation trigger, go server.go:196-200).
+"""
+
+import threading
+
+import numpy as np
+
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.ps.optimizer import PSOptimizer
+from elasticdl_tpu.ps.parameters import Parameters
+
+logger = get_logger("ps.servicer")
+
+DEFAULT_REPORT_VERSION_STEPS = 100
+
+
+class PserverServicer:
+    def __init__(
+        self,
+        parameters: Parameters,
+        optimizer: PSOptimizer,
+        use_async=True,
+        grads_to_wait=1,
+        sync_version_tolerance=0,
+        lr_staleness_modulation=False,
+        checkpoint_saver=None,
+        checkpoint_steps=0,
+        master_client=None,
+        report_version_steps=DEFAULT_REPORT_VERSION_STEPS,
+    ):
+        self._params = parameters
+        self._opt = optimizer
+        self._use_async = use_async
+        self._grads_to_wait = grads_to_wait
+        self._sync_version_tolerance = sync_version_tolerance
+        self._lr_staleness_modulation = lr_staleness_modulation
+        self._checkpoint_saver = checkpoint_saver
+        self._checkpoint_steps = checkpoint_steps
+        self._mc = master_client
+        self._report_version_steps = report_version_steps
+        self._version_lock = threading.Lock()
+        # sync-mode accumulation state (guarded by _version_lock)
+        self._grad_sum = {}  # dense name -> np array
+        self._grad_n = 0
+        self._sparse_acc = {}  # table name -> ([values...], [ids...])
+
+    # ---------- rpc methods (names match rpc.PSERVER_SERVICE) ----------
+
+    def push_model(self, request, context):
+        did_init = self._params.init_from_model_pb(request)
+        if did_init:
+            logger.info(
+                "Model initialized from worker push: %d dense, %d tables, "
+                "version %d",
+                len(self._params.dense),
+                len(self._params.embedding_tables),
+                self._params.version,
+            )
+        return pb.Empty()
+
+    def push_embedding_table_infos(self, request, context):
+        with self._params.init_lock:
+            self._params.init_embedding_infos(
+                request.embedding_table_infos
+            )
+        return pb.Empty()
+
+    def pull_dense_parameters(self, request, context):
+        if not self._params.initialized:
+            return pb.PullDenseParametersResponse(initialized=False)
+        # Under async SGD workers poll with their current version and only
+        # need deltas; we return everything newer-or-equal (the reference
+        # returns all when version lags, go server.go:144-160).
+        res = pb.PullDenseParametersResponse(
+            initialized=True, version=self._params.version
+        )
+        if request.version < self._params.version or request.version == 0:
+            for name in sorted(self._params.dense):
+                res.dense_parameters.append(
+                    tensor_utils.ndarray_to_tensor_pb(
+                        self._params.dense[name], name
+                    )
+                )
+        return res
+
+    def pull_embedding_vectors(self, request, context):
+        table = self._params.embedding_tables.get(request.name)
+        if table is None:
+            raise ValueError(f"unknown embedding table {request.name!r}")
+        if not request.ids:
+            return pb.Tensor(name=request.name)
+        values = table.lookup(np.asarray(request.ids, dtype=np.int64))
+        return tensor_utils.ndarray_to_tensor_pb(values, request.name)
+
+    def push_gradients(self, request, context):
+        if self._use_async:
+            return self._push_async(request)
+        return self._push_sync(request)
+
+    # ---------- async path ----------
+
+    def _push_async(self, request):
+        staleness = max(
+            1, self._params.version - request.gradients.version
+        )
+        if self._lr_staleness_modulation:
+            self._opt.lr_modulator.set_multiplier(1.0 / staleness)
+        # Applies serialize on the version lock: ctypes releases the GIL, so
+        # unsynchronized concurrent native updates of one buffer would race
+        # (the reference Go server likewise applies under its mutex,
+        # go/pkg/ps/server.go:67-68,176-206).
+        with self._version_lock:
+            self._apply_model_pb(request.gradients)
+            self._params.version += 1
+            version = self._params.version
+        self._post_apply(version)
+        return pb.PushGradientsResponse(accepted=True, version=version)
+
+    # ---------- sync path ----------
+
+    def _push_sync(self, request):
+        with self._version_lock:
+            if (
+                request.gradients.version
+                < self._params.version - self._sync_version_tolerance
+            ):
+                return pb.PushGradientsResponse(
+                    accepted=False, version=self._params.version
+                )
+            for t in request.gradients.dense_parameters:
+                arr = tensor_utils.tensor_pb_to_ndarray(t)
+                if t.name in self._grad_sum:
+                    self._grad_sum[t.name] += arr
+                else:
+                    self._grad_sum[t.name] = arr
+            for name, slices in request.gradients.embedding_tables.items():
+                values, ids = tensor_utils.indexed_slices_pb_to_ndarrays(
+                    slices
+                )
+                acc = self._sparse_acc.setdefault(name, ([], []))
+                acc[0].append(values)
+                acc[1].append(ids)
+            self._grad_n += 1
+            if self._grad_n < self._grads_to_wait:
+                return pb.PushGradientsResponse(
+                    accepted=True, version=self._params.version
+                )
+            # Quorum reached: average dense, merge sparse, apply once.
+            for name, g in self._grad_sum.items():
+                self._opt.apply_dense(
+                    name, self._params.dense[name], g / self._grad_n
+                )
+            for name, (values_list, ids_list) in self._sparse_acc.items():
+                values, ids = tensor_utils.merge_indexed_slices(
+                    values_list, ids_list
+                )
+                values /= self._grad_n
+                self._opt.apply_sparse(
+                    self._params.embedding_tables[name], ids, values
+                )
+            self._grad_sum.clear()
+            self._sparse_acc.clear()
+            self._grad_n = 0
+            self._params.version += 1
+            version = self._params.version
+        self._post_apply(version)
+        return pb.PushGradientsResponse(accepted=True, version=version)
+
+    # ---------- shared ----------
+
+    def _apply_model_pb(self, gradients):
+        for t in gradients.dense_parameters:
+            param = self._params.dense.get(t.name)
+            if param is None:
+                raise ValueError(f"gradient for unknown parameter {t.name!r}")
+            self._opt.apply_dense(
+                t.name, param, tensor_utils.tensor_pb_to_ndarray(t)
+            )
+        for name, slices in gradients.embedding_tables.items():
+            table = self._params.embedding_tables.get(name)
+            if table is None:
+                raise ValueError(f"gradient for unknown table {name!r}")
+            values, ids = tensor_utils.indexed_slices_pb_to_ndarrays(slices)
+            self._opt.apply_sparse(table, ids, values)
+
+    def _post_apply(self, version):
+        if (
+            self._checkpoint_saver is not None
+            and self._checkpoint_steps
+            and version % self._checkpoint_steps == 0
+        ):
+            try:
+                self._checkpoint_saver.save(version, self._params)
+            except Exception:
+                logger.error(
+                    "Checkpoint at version %d failed", version, exc_info=True
+                )
+        if (
+            self._mc is not None
+            and version % self._report_version_steps == 0
+        ):
+            try:
+                self._mc.report_version(version)
+            except Exception:
+                logger.warning(
+                    "report_version(%d) to master failed", version
+                )

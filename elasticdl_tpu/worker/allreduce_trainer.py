@@ -21,11 +21,12 @@ rendezvous manager. TPU-first redesign:
   retries <=5 on Horovod UnknownError, allreduce_trainer.py:125-139).
 """
 
+import threading
 import time
 
+import grpc
 import jax
 import numpy as np
-import optax
 
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.parallel import broadcast, distributed
@@ -42,6 +43,14 @@ logger = get_logger("worker.allreduce_trainer")
 
 DEFAULT_STEPS_PER_WORLD_CHECK = 20
 DEFAULT_MAX_COMM_RETRIES = 5
+
+# What counts as a communication/runtime failure worth a re-mesh + retry.
+# XLA/distributed-runtime errors surface as RuntimeError subclasses
+# (XlaRuntimeError); master RPCs fail as grpc.RpcError. User-code bugs
+# (TypeError/ValueError from tracing a bad model or loss) must NOT retry —
+# the reference similarly retried only Horovod comm errors
+# (allreduce_trainer.py:125-139).
+RETRYABLE_ERRORS = (grpc.RpcError, RuntimeError)
 
 
 class AllReduceTrainer(JaxTrainer):
@@ -68,6 +77,11 @@ class AllReduceTrainer(JaxTrainer):
         self._mesh = None
         self._sharded_steps = {}  # real_n -> jitted step
         self._steps_since_check = 0
+        # Guards the (variables, opt_state, version) triple: the broadcast
+        # server reads it from gRPC threads while the training thread swaps
+        # it, and a torn read would hand a joiner step-N+1 weights with
+        # step-N optimizer moments.
+        self._state_lock = threading.Lock()
         # Every worker serves its state; only the rank-0 instance gets pulled
         # from. Port 0 binds an ephemeral port that the worker advertises as
         # part of its host string: the master hands that "ip:port" string out
@@ -92,13 +106,14 @@ class AllReduceTrainer(JaxTrainer):
         return self._world_size
 
     def _state_provider(self):
-        if self._variables is None:
-            return None
-        return (
-            jax.device_get(self._variables),
-            jax.device_get(self._opt_state),
-            self._version,
-        )
+        with self._state_lock:
+            if self._variables is None:
+                return None
+            return (
+                jax.device_get(self._variables),
+                jax.device_get(self._opt_state),
+                self._version,
+            )
 
     # ---------- world management ----------
 
@@ -132,6 +147,7 @@ class AllReduceTrainer(JaxTrainer):
                 f"{coordinator_ip}:{resp.rendezvous_port}",
                 resp.world_size,
                 resp.rank_id,
+                epoch=resp.rendezvous_id,
             )
         self._mesh = make_mesh()
         self._sharded_steps = {}
@@ -142,20 +158,18 @@ class AllReduceTrainer(JaxTrainer):
         if host_state is not None:
             variables, opt_state, version = host_state
             repl = replicated_sharding(self._mesh)
-            self._variables = jax.device_put(variables, repl)
-            self._opt_state = jax.device_put(opt_state, repl)
-            self._version = version
+            with self._state_lock:
+                self._variables = jax.device_put(variables, repl)
+                self._opt_state = jax.device_put(opt_state, repl)
+                self._version = version
         self._group_id = resp.rendezvous_id
 
     def _pull_from_rank0(self, coordinator_addr):
         if self._variables is None:
             return None  # nothing local to align; init will seed from data
-        v_treedef = jax.tree_util.tree_structure(
-            jax.device_get(self._variables)
-        )
-        o_treedef = jax.tree_util.tree_structure(
-            jax.device_get(self._opt_state)
-        )
+        # treedefs describe containers only — no device transfer needed.
+        v_treedef = jax.tree_util.tree_structure(self._variables)
+        o_treedef = jax.tree_util.tree_structure(self._opt_state)
         try:
             state = broadcast.pull_state(
                 coordinator_addr, v_treedef, o_treedef
@@ -187,55 +201,19 @@ class AllReduceTrainer(JaxTrainer):
             repl = replicated_sharding(self._mesh)
             data = data_sharding(self._mesh)
 
+            # Slicing padding rows off before the loss keeps partial
+            # minibatches bit-identical to single-device training. The
+            # slice index is a LOCAL row count, only meaningful when one
+            # process owns the whole global batch; in multi-host runs the
+            # loss is taken over the full padded global batch instead —
+            # padding is cyclic repetition of real rows, so only a task's
+            # final partial minibatch is (slightly) reweighted, matching
+            # the reference's ragged-last-batch Horovod averaging.
+            slice_to = real_n if jax.process_count() == 1 else None
+
             def step_fn(variables, opt_state, rng, features, labels):
-                params = variables["params"]
-                state = {
-                    k: v for k, v in variables.items() if k != "params"
-                }
-
-                # Slicing padding rows off before the loss keeps partial
-                # minibatches bit-identical to single-device training. The
-                # slice index is a LOCAL row count, only meaningful when one
-                # process owns the whole global batch; in multi-host runs the
-                # loss is taken over the full padded global batch instead —
-                # padding is cyclic repetition of real rows, so only a task's
-                # final partial minibatch is (slightly) reweighted, matching
-                # the reference's ragged-last-batch Horovod averaging.
-                slice_to = real_n if jax.process_count() == 1 else None
-
-                def loss_of(p):
-                    mutable = [k for k in state]
-                    out = self._model.apply(
-                        {"params": p, **state},
-                        features,
-                        training=True,
-                        rngs={"dropout": rng},
-                        mutable=mutable if mutable else False,
-                    )
-                    outputs, new_state = (
-                        out if mutable else (out, state)
-                    )
-                    labels_real = labels
-                    if slice_to is not None:
-                        outputs = jax.tree_util.tree_map(
-                            lambda o: o[:slice_to], outputs
-                        )
-                        labels_real = jax.tree_util.tree_map(
-                            lambda l: l[:slice_to], labels
-                        )
-                    return self._loss_fn(labels_real, outputs), new_state
-
-                (loss, new_state), grads = jax.value_and_grad(
-                    loss_of, has_aux=True
-                )(params)
-                updates, new_opt_state = self._optax.update(
-                    grads, opt_state, params
-                )
-                new_params = optax.apply_updates(params, updates)
-                return (
-                    {"params": new_params, **new_state},
-                    new_opt_state,
-                    loss,
+                return self._step_body(
+                    variables, opt_state, rng, features, labels, slice_to
                 )
 
             # No buffer donation here (unlike the local trainer): a comm
@@ -273,9 +251,8 @@ class AllReduceTrainer(JaxTrainer):
         for attempt in range(self._max_comm_retries):
             try:
                 loss = self._run_sharded_step(features, labels)
-                self._version += 1
                 return True, self._version, float(loss)
-            except Exception:
+            except RETRYABLE_ERRORS:
                 if attempt == self._max_comm_retries - 1:
                     raise
                 logger.warning(
@@ -294,13 +271,17 @@ class AllReduceTrainer(JaxTrainer):
         step = self._sharded_step_for(real_n, padded_n)
         self._rng, step_rng = jax.random.split(self._rng)
         with self._mesh:
-            self._variables, self._opt_state, loss = step(
+            new_variables, new_opt_state, loss = step(
                 self._variables,
                 self._opt_state,
                 step_rng,
                 shard_batch(padded_f, self._mesh),
                 shard_batch(padded_l, self._mesh),
             )
+        with self._state_lock:
+            self._variables = new_variables
+            self._opt_state = new_opt_state
+            self._version += 1
         return loss
 
     def close(self):

@@ -1,0 +1,203 @@
+"""Worker-side client for the sharded parameter servers.
+
+Reference counterpart: /root/reference/elasticdl/python/worker/
+ps_client.py:32-246. Partitioning kept bit-compatible with the store:
+dense parameters by sha256(name) mod N, embedding ids by id mod N
+(common/hash_utils.py). All fan-outs use gRPC futures so the N shards work
+in parallel; sparse grads are merged/deduplicated *before* the wire
+(ps_client.py:135-232).
+"""
+
+import numpy as np
+
+from elasticdl_tpu.common import hash_utils, rpc, tensor_utils
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+
+class PSClient:
+    def __init__(self, ps_addrs):
+        """ps_addrs: list of "host:port", index = ps_id."""
+        self._addrs = list(ps_addrs)
+        self._channels = [rpc.build_channel(a) for a in self._addrs]
+        self._stubs = [
+            rpc.Stub(ch, rpc.PSERVER_SERVICE) for ch in self._channels
+        ]
+        self.num_ps = len(self._stubs)
+        # Per-shard pull cursors: each shard's version advances independently
+        # (only pushes touching it bump it), so "what have I already got"
+        # must be tracked per shard, not as one global number.
+        self._dense_versions = [-1] * self.num_ps
+
+    def close(self):
+        for ch in self._channels:
+            ch.close()
+
+    # ---------- partitioning ----------
+
+    def partition_dense_names(self, names):
+        """{ps_id: [names]} by stable name hash."""
+        parts = {}
+        for name in names:
+            parts.setdefault(
+                hash_utils.string_to_id(name, self.num_ps), []
+            ).append(name)
+        return parts
+
+    # ---------- model init / re-seed ----------
+
+    def push_model(self, dense_params, embedding_infos=None, version=0):
+        """Push each PS its shard of the dense params + all table infos
+        (first-worker init AND the PS-restart re-seed path)."""
+        parts = self.partition_dense_names(dense_params)
+        futures = []
+        for ps_id, stub in enumerate(self._stubs):
+            model = pb.Model(version=version)
+            for name in parts.get(ps_id, []):
+                model.dense_parameters.append(
+                    tensor_utils.ndarray_to_tensor_pb(
+                        np.ascontiguousarray(
+                            dense_params[name], dtype=np.float32
+                        ),
+                        name,
+                    )
+                )
+            for info in embedding_infos or []:
+                model.embedding_table_infos.append(info)
+            futures.append(stub.push_model.future(model))
+        for f in futures:
+            f.result()
+
+    def push_embedding_table_infos(self, infos):
+        model = pb.Model()
+        model.embedding_table_infos.extend(infos)
+        futures = [
+            stub.push_embedding_table_infos.future(model)
+            for stub in self._stubs
+        ]
+        for f in futures:
+            f.result()
+
+    # ---------- pulls ----------
+
+    def pull_dense_parameters(self, names, version=None):
+        """Pull the given dense params from their shards.
+
+        version=None uses the internal per-shard cursors (each shard only
+        re-sends params newer than what this client already pulled);
+        an explicit version overrides for all shards.
+
+        Returns (all_initialized, max_version, {name: ndarray}); params is
+        partial when some shard reported initialized=False (that shard needs
+        a re-seed via push_model)."""
+        parts = self.partition_dense_names(names)
+        futures = {
+            ps_id: self._stubs[ps_id].pull_dense_parameters.future(
+                pb.PullDenseParametersRequest(
+                    version=self._dense_versions[ps_id]
+                    if version is None
+                    else version
+                )
+            )
+            for ps_id in range(self.num_ps)
+        }
+        params, initialized, max_version = {}, True, 0
+        for ps_id, f in futures.items():
+            res = f.result()
+            if not res.initialized:
+                initialized = False
+                # Force a full re-pull from this shard once it comes back.
+                self._dense_versions[ps_id] = -1
+                continue
+            self._dense_versions[ps_id] = res.version
+            max_version = max(max_version, res.version)
+            wanted = set(parts.get(ps_id, []))
+            for t in res.dense_parameters:
+                if t.name in wanted:
+                    params[t.name] = tensor_utils.tensor_pb_to_ndarray(t)
+        return initialized, max_version, params
+
+    def pull_embedding_vectors(self, name, ids):
+        """ids [k] -> [k, dim] rows, gathered across shards by id modulo and
+        restored to input order."""
+        ids = np.asarray(ids, dtype=np.int64)
+        if ids.size == 0:
+            return None
+        scattered = hash_utils.scatter_embedding_ids(ids, self.num_ps)
+        futures = {
+            ps_id: (
+                positions,
+                self._stubs[ps_id].pull_embedding_vectors.future(
+                    pb.PullEmbeddingVectorsRequest(
+                        name=name, ids=shard_ids.tolist()
+                    )
+                ),
+            )
+            for ps_id, (shard_ids, positions) in scattered.items()
+        }
+        out = None
+        for ps_id, (positions, f) in futures.items():
+            values = tensor_utils.tensor_pb_to_ndarray(f.result())
+            if out is None:
+                out = np.empty(
+                    (len(ids), values.shape[1]), dtype=values.dtype
+                )
+            out[positions] = values
+        return out
+
+    # ---------- gradient push ----------
+
+    def push_gradients(
+        self, dense_grads, sparse_grads, version, learning_rate=0.0
+    ):
+        """dense_grads: {name: ndarray}; sparse_grads:
+        {table_name: (values [k, dim], ids [k])} — deduplicated here before
+        partitioning. Returns (accepted_all, max_version)."""
+        dense_parts = self.partition_dense_names(dense_grads)
+        shard_models = {}
+
+        def model_for(ps_id):
+            if ps_id not in shard_models:
+                shard_models[ps_id] = pb.Model(version=version)
+            return shard_models[ps_id]
+
+        for ps_id, names in dense_parts.items():
+            m = model_for(ps_id)
+            for name in names:
+                m.dense_parameters.append(
+                    tensor_utils.ndarray_to_tensor_pb(
+                        np.ascontiguousarray(
+                            dense_grads[name], dtype=np.float32
+                        ),
+                        name,
+                    )
+                )
+        for table, (values, ids) in sparse_grads.items():
+            values, ids = tensor_utils.deduplicate_indexed_slices(
+                np.asarray(values, dtype=np.float32),
+                np.asarray(ids, dtype=np.int64),
+            )
+            for ps_id, (shard_ids, positions) in (
+                hash_utils.scatter_embedding_ids(ids, self.num_ps).items()
+            ):
+                m = model_for(ps_id)
+                m.embedding_tables[table].CopyFrom(
+                    tensor_utils.ndarray_to_indexed_slices_pb(
+                        np.ascontiguousarray(values[positions]),
+                        shard_ids,
+                        table,
+                    )
+                )
+        futures = [
+            self._stubs[ps_id].push_gradients.future(
+                pb.PushGradientsRequest(
+                    gradients=m, learning_rate=learning_rate
+                )
+            )
+            for ps_id, m in shard_models.items()
+        ]
+        accepted, max_version = True, 0
+        for f in futures:
+            res = f.result()
+            accepted = accepted and res.accepted
+            max_version = max(max_version, res.version)
+        return accepted, max_version

@@ -1,0 +1,281 @@
+"""Parameter-server-strategy trainer.
+
+Reference counterpart: /root/reference/elasticdl/python/worker/
+ps_trainer.py:36-441. Behaviors kept:
+
+- pull dense params before stepping; a shard answering initialized=False is
+  re-seeded by pushing local weights (the PS crash-recovery path,
+  ps_trainer.py:149-184) — verified by test_ps_restart_reseed.
+- fwd/bwd is one jitted function; embedding rows are prefetched OUTSIDE the
+  step and differentiated as inputs (see layers/embedding.py for why this
+  replaces the reference's mid-forward py_function RPC under XLA).
+- gradients partition/merge/push via PSClient; a sync-mode rejection
+  (stale version) re-pulls and recomputes the minibatch
+  (ps_trainer.py:372-386).
+
+Worker-side params are a cache of PS state (async SGD): the PS owns the
+model version; the worker never applies updates locally.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.layers.embedding import EMBEDDING_COLLECTION
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.worker.trainer import JaxTrainer, _to_device_batch
+
+logger = get_logger("worker.ps_trainer")
+
+DEFAULT_MAX_PUSH_RETRIES = 3
+
+
+def flatten_params(params):
+    """params pytree -> ({wire_name: leaf}, [names in leaf order]). Names
+    are '/'-joined dict paths ('Dense_0/kernel'), stable across workers."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    named = {}
+    names = []
+    for path, leaf in flat:
+        name = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+        )
+        named[name] = leaf
+        names.append(name)
+    return named, names
+
+
+def _walk_dict(tree, path=()):
+    """Yield (path_tuple, leaf) over a nested dict (flax FrozenDict or dict).
+    """
+    for k, v in tree.items():
+        if hasattr(v, "items"):
+            yield from _walk_dict(v, path + (k,))
+        else:
+            yield path + (k,), v
+
+
+def _nest_at(paths_to_values):
+    """{path_tuple: value} -> nested dict."""
+    nested = {}
+    for path, value in paths_to_values.items():
+        node = nested
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = value
+    return nested
+
+
+def unflatten_like(params, named):
+    """Rebuild a params-shaped pytree taking leaves from `named` by wire
+    name (missing names keep the existing leaf)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    leaves = []
+    for path, leaf in flat:
+        name = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+        )
+        leaves.append(named.get(name, leaf))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class ParameterServerTrainer(JaxTrainer):
+    def __init__(
+        self,
+        model,
+        loss_fn,
+        optimizer_spec,
+        ps_client,
+        embedding_inputs=None,
+        use_async=True,
+        max_push_retries=DEFAULT_MAX_PUSH_RETRIES,
+        seed=0,
+    ):
+        super().__init__(model, loss_fn, optimizer_spec, seed=seed)
+        self._ps = ps_client
+        # callable(features) -> {table_name: ids ndarray}; required iff the
+        # model contains DistributedEmbedding layers (PS mode).
+        self._embedding_inputs = embedding_inputs
+        self._use_async = use_async
+        self._max_push_retries = max_push_retries
+        self._param_names = None
+        self._embedding_dims = {}  # table -> dim, derived at init
+        # table -> module-scope path inside the edl_embedding collection
+        # (flax nests collection entries under the owning module's path).
+        self._embedding_paths = {}
+        self._ps_step = None
+        self._ps_forward = None
+
+    # ---------- init ----------
+
+    def init_variables_if_needed(self, features):
+        if self._variables is not None:
+            return
+        super().init_variables_if_needed(features)
+        # The init-created embedding collection only carried shapes; rows
+        # arrive per-batch. Record each table's dim and scope path, then
+        # drop the collection from state.
+        emb = self._variables.pop(EMBEDDING_COLLECTION, {})
+        for path, leaf in _walk_dict(emb):
+            table = path[-1]  # innermost key is the table_name
+            self._embedding_dims[table] = int(leaf.shape[-1])
+            self._embedding_paths[table] = path
+        if self._embedding_dims and self._embedding_inputs is None:
+            raise ValueError(
+                "model has DistributedEmbedding layers "
+                f"{sorted(self._embedding_dims)} but no embedding_inputs "
+                "feed was provided to ParameterServerTrainer"
+            )
+        _, self._param_names = flatten_params(self._variables["params"])
+        # First worker seeds the PS; later pushes are ignored there.
+        self._push_local_model()
+        self._ps_step = self._build_ps_step()
+        self._ps_forward = self._build_ps_forward()
+
+    def _embedding_infos(self):
+        return [
+            pb.EmbeddingTableInfo(
+                name=name, dim=dim, initializer="uniform", dtype=pb.DT_FLOAT32
+            )
+            for name, dim in sorted(self._embedding_dims.items())
+        ]
+
+    def _push_local_model(self):
+        named, _ = flatten_params(jax.device_get(self._variables["params"]))
+        self._ps.push_model(
+            named, self._embedding_infos(), version=self._version
+        )
+
+    # ---------- PS sync ----------
+
+    def _sync_model(self):
+        """Pull dense params; re-seed any uninitialized shard from local
+        weights (that IS the PS fault-tolerance path)."""
+        # The PSClient tracks per-shard pull cursors: a shard only re-sends
+        # params newer than this client's last pull from it.
+        initialized, version, named = self._ps.pull_dense_parameters(
+            self._param_names
+        )
+        if not initialized:
+            logger.info("Uninitialized PS shard found; re-seeding from local")
+            self._push_local_model()
+            initialized, version, named = self._ps.pull_dense_parameters(
+                self._param_names
+            )
+            if not initialized:
+                raise RuntimeError("PS still uninitialized after re-seed")
+        if named:
+            self._variables["params"] = unflatten_like(
+                self._variables["params"],
+                {k: jnp.asarray(v) for k, v in named.items()},
+            )
+        self._version = max(self._version, version)
+
+    def _prefetch_embeddings(self, features):
+        """features -> (rows {table: [n_positions, dim]}, flat_ids
+        {table: [n_positions]}). Pulls unique ids only; expands back by
+        inverse so the in-jit layer does a plain reshape."""
+        if not self._embedding_dims:
+            return {}, {}
+        by_path, flat_ids = {}, {}
+        for table, ids in self._embedding_inputs(features).items():
+            ids = np.asarray(ids, dtype=np.int64).reshape(-1)
+            unique, inverse = np.unique(ids, return_inverse=True)
+            pulled = self._ps.pull_embedding_vectors(table, unique)
+            by_path[self._embedding_paths[table]] = jnp.asarray(
+                pulled[inverse]
+            )
+            flat_ids[table] = ids
+        return _nest_at(by_path), flat_ids
+
+    # ---------- jitted steps ----------
+
+    def _build_ps_step(self):
+        def step(params, state, emb_rows, rng, features, labels):
+            def loss_of(p, rows):
+                mutable = [k for k in state]
+                out = self._model.apply(
+                    {"params": p, **state, EMBEDDING_COLLECTION: rows},
+                    features,
+                    training=True,
+                    rngs={"dropout": rng},
+                    mutable=mutable if mutable else False,
+                )
+                outputs, new_state = out if mutable else (out, state)
+                return self._loss_fn(labels, outputs), new_state
+
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_of, argnums=(0, 1), has_aux=True
+            )(params, emb_rows)
+            return loss, grads[0], grads[1], new_state
+
+        return jax.jit(step)
+
+    def _build_ps_forward(self):
+        def forward(params, state, emb_rows, features):
+            return self._model.apply(
+                {"params": params, **state, EMBEDDING_COLLECTION: emb_rows},
+                features,
+                training=False,
+            )
+
+        return jax.jit(forward)
+
+    # ---------- Trainer interface ----------
+
+    def train_minibatch(self, features, labels):
+        self.init_variables_if_needed(features)
+        device_features = _to_device_batch(features)
+        device_labels = _to_device_batch(labels)
+        for attempt in range(self._max_push_retries):
+            self._sync_model()
+            emb_rows, flat_ids = self._prefetch_embeddings(features)
+            self._rng, step_rng = jax.random.split(self._rng)
+            state = {
+                k: v for k, v in self._variables.items() if k != "params"
+            }
+            loss, param_grads, emb_grads, new_state = self._ps_step(
+                self._variables["params"],
+                state,
+                emb_rows,
+                step_rng,
+                device_features,
+                device_labels,
+            )
+            self._variables.update(new_state)
+            dense_named, _ = flatten_params(jax.device_get(param_grads))
+            sparse = {}
+            for path, g in _walk_dict(emb_grads):
+                table = path[-1]
+                sparse[table] = (
+                    np.asarray(g).reshape(-1, self._embedding_dims[table]),
+                    flat_ids[table],
+                )
+            accepted, version = self._ps.push_gradients(
+                dense_named, sparse, version=self._version
+            )
+            self._version = max(self._version, version)
+            if accepted:
+                return True, self._version, float(loss)
+            logger.info(
+                "Gradient push rejected as stale (attempt %d); re-pulling",
+                attempt + 1,
+            )
+        return False, self._version, float(loss)
+
+    def evaluate_minibatch(self, features, model_version=-1):
+        self.init_variables_if_needed(features)
+        self._sync_model()
+        emb_rows, _ = self._prefetch_embeddings(features)
+        state = {k: v for k, v in self._variables.items() if k != "params"}
+        outputs = self._ps_forward(
+            self._variables["params"],
+            state,
+            emb_rows,
+            _to_device_batch(features),
+        )
+        return jax.tree_util.tree_map(np.asarray, outputs)
+
+    def get_model_version(self):
+        return self._version

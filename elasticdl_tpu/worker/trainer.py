@@ -95,8 +95,11 @@ class JaxTrainer(Trainer):
 
     # ---------- step functions ----------
 
-    def _apply_train(self, params, state, rng, features, labels):
-        """Pure fwd+bwd+update; the body every strategy shares."""
+    def _apply_train(self, params, state, rng, features, labels,
+                     slice_to=None):
+        """Pure fwd+bwd; the body every strategy shares. slice_to trims
+        padding rows off outputs/labels before the loss (used by sharded
+        strategies that pad batches to the mesh size)."""
         mutable = [k for k in state]
 
         def loss_of(p):
@@ -108,27 +111,38 @@ class JaxTrainer(Trainer):
                 mutable=mutable if mutable else False,
             )
             outputs, new_state = out if mutable else (out, state)
-            return self._loss_fn(labels, outputs), new_state
+            labels_real = labels
+            if slice_to is not None:
+                outputs = jax.tree_util.tree_map(
+                    lambda o: o[:slice_to], outputs
+                )
+                labels_real = jax.tree_util.tree_map(
+                    lambda l: l[:slice_to], labels
+                )
+            return self._loss_fn(labels_real, outputs), new_state
 
         (loss, new_state), grads = jax.value_and_grad(
             loss_of, has_aux=True
         )(params)
         return loss, grads, new_state
 
-    def _build_train_step(self):
-        def step(variables, opt_state, rng, features, labels):
-            params = variables["params"]
-            state = {k: v for k, v in variables.items() if k != "params"}
-            loss, grads, new_state = self._apply_train(
-                params, state, rng, features, labels
-            )
-            updates, new_opt_state = self._optax.update(
-                grads, opt_state, params
-            )
-            new_params = optax.apply_updates(params, updates)
-            return {"params": new_params, **new_state}, new_opt_state, loss
+    def _step_body(self, variables, opt_state, rng, features, labels,
+                   slice_to=None):
+        """fwd + bwd + optimizer update; shared by every on-device-update
+        strategy (local and AllReduce)."""
+        params = variables["params"]
+        state = {k: v for k, v in variables.items() if k != "params"}
+        loss, grads, new_state = self._apply_train(
+            params, state, rng, features, labels, slice_to
+        )
+        updates, new_opt_state = self._optax.update(
+            grads, opt_state, params
+        )
+        new_params = optax.apply_updates(params, updates)
+        return {"params": new_params, **new_state}, new_opt_state, loss
 
-        return jax.jit(step, donate_argnums=(0, 1))
+    def _build_train_step(self):
+        return jax.jit(self._step_body, donate_argnums=(0, 1))
 
     def _build_forward(self):
         def forward(variables, features):

@@ -1,0 +1,78 @@
+"""Toy model spec with a PS-resident embedding (the reference's
+embedding_test_module.py pattern)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.common.model_utils import Modes
+from elasticdl_tpu.data.example import batch_examples, encode_example
+from elasticdl_tpu.layers.embedding import DistributedEmbedding
+from elasticdl_tpu.ops import optimizers
+
+VOCAB = 20
+EMB_DIM = 4
+DENSE_DIM = 3
+IDS_PER_EXAMPLE = 2
+
+
+class EmbeddingModel(nn.Module):
+    """score = Dense([sum-combined embedding, x])"""
+
+    vocab_size: int = 0  # 0 => PS-resident; >0 => local trainable table
+
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        emb = DistributedEmbedding(
+            table_name="item_emb",
+            dim=EMB_DIM,
+            combiner="sum",
+            vocab_size=self.vocab_size,
+        )(features["ids"])
+        h = jnp.concatenate([emb, features["x"]], axis=-1)
+        return nn.Dense(1)(h)
+
+
+def custom_model():
+    return EmbeddingModel()
+
+
+def loss(labels, predictions):
+    return jnp.mean((predictions.reshape(-1) - labels.reshape(-1)) ** 2)
+
+
+def optimizer():
+    return optimizers.sgd(learning_rate=0.05)
+
+
+def feed(records, mode, metadata):
+    batch = batch_examples(records)
+    labels = batch.get("y")
+    return {"ids": batch["ids"], "x": batch["x"]}, labels
+
+
+def embedding_inputs(features):
+    return {"item_emb": features["ids"]}
+
+
+def eval_metrics_fn():
+    return {}
+
+
+# Ground truth: fixed random table + linear head, exactly representable.
+_rng = np.random.default_rng(42)
+TRUE_TABLE = _rng.normal(scale=0.5, size=(VOCAB, EMB_DIM)).astype(np.float32)
+TRUE_WE = _rng.normal(size=(EMB_DIM,)).astype(np.float32)
+TRUE_WX = _rng.normal(size=(DENSE_DIM,)).astype(np.float32)
+
+
+def make_records(n, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, VOCAB, size=(n, IDS_PER_EXAMPLE)).astype(np.int64)
+    x = rng.normal(size=(n, DENSE_DIM)).astype(np.float32)
+    emb_sum = TRUE_TABLE[ids].sum(axis=1)
+    y = (emb_sum @ TRUE_WE + x @ TRUE_WX).astype(np.float32)
+    return [
+        encode_example({"ids": ids[i], "x": x[i], "y": np.float32(y[i])})
+        for i in range(n)
+    ]
