@@ -1,0 +1,1 @@
+"""The `edl` command-line client (reference: elasticdl_client/)."""

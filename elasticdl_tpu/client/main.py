@@ -1,0 +1,273 @@
+"""`edl` CLI: submit/run elastic training jobs.
+
+Reference counterpart: /root/reference/elasticdl_client/main.py:28-107 and
+api.py:116-248. Subcommands:
+
+  edl train    --model_def ... --training_data ...
+  edl evaluate --model_def ... --validation_data ... --checkpoint_dir_for_init ...
+  edl predict  --model_def ... --prediction_data ... --checkpoint_dir_for_init ...
+  edl zoo init / edl zoo list
+
+Submission modes:
+  --instance_backend local_process (default): the master runs IN THIS
+      process and spawns worker/PS subprocesses on this host — the TPU-VM
+      single-host path (no Docker build step; TPU hosts run the package
+      directly).
+  --instance_backend k8s: the master pod is created via the kubernetes API
+      (requires the kubernetes package + cluster credentials); --yaml dumps
+      the master pod manifest instead of creating it, mirroring the
+      reference's --yaml mode (api.py:217-232).
+"""
+
+import argparse
+import os
+import shutil
+import sys
+
+from elasticdl_tpu.common import args as args_mod
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("client.main")
+
+
+def _job_parser(name):
+    p = argparse.ArgumentParser(f"edl {name}", add_help=True)
+    args_mod.add_common_arguments(p)
+    args_mod.add_data_arguments(p)
+    args_mod.add_train_arguments(p)
+    args_mod.add_cluster_arguments(p)
+    args_mod.add_ps_arguments(p)
+    p.add_argument(
+        "--yaml",
+        default="",
+        help="(k8s) write the master pod manifest to this file instead of "
+        "creating it",
+    )
+    return p
+
+
+def _run_master_in_process(argv):
+    from elasticdl_tpu.master.main import main as master_main
+
+    return master_main(argv)
+
+
+def _submit(job_args, raw_argv):
+    args_mod.validate_args(job_args)
+    if job_args.instance_backend == "k8s":
+        return _submit_k8s(job_args, raw_argv)
+    return _run_master_in_process(raw_argv)
+
+
+def _strip_flag(argv, flag):
+    """Drop '--flag value' and '--flag=value' forms from an argv list."""
+    out = []
+    skip_next = False
+    for a in argv:
+        if skip_next:
+            skip_next = False
+            continue
+        if a == flag:
+            skip_next = True
+            continue
+        if a.startswith(flag + "="):
+            continue
+        out.append(a)
+    return out
+
+
+def _master_pod_manifest(job_args, raw_argv):
+    command = ["python", "-m", "elasticdl_tpu.master.main"] + _strip_flag(
+        raw_argv, "--yaml"
+    )
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"elasticdl-{job_args.job_name}-master",
+            "labels": {
+                "app": "elasticdl",
+                "elasticdl-job-name": job_args.job_name,
+                "elasticdl-replica-type": "master",
+            },
+        },
+        "spec": {
+            "serviceAccountName": "elasticdl-master",
+            "restartPolicy": "Never",
+            "containers": [
+                {
+                    "name": "master",
+                    "image": job_args.image_name,
+                    "command": command,
+                    "env": [
+                        {
+                            "name": "MY_POD_IP",
+                            "valueFrom": {
+                                "fieldRef": {"fieldPath": "status.podIP"}
+                            },
+                        }
+                    ],
+                }
+            ],
+        },
+    }
+
+
+def _submit_k8s(job_args, raw_argv):
+    manifest = _master_pod_manifest(job_args, raw_argv)
+    if job_args.yaml:
+        import json
+
+        with open(job_args.yaml, "w") as f:
+            json.dump(manifest, f, indent=2)
+        logger.info("Wrote master pod manifest to %s", job_args.yaml)
+        return 0
+    from elasticdl_tpu.common import k8s_client
+
+    k8s_client.require_k8s()
+    client = k8s_client.Client(
+        job_args.namespace, job_args.job_name, job_args.image_name
+    )
+    # The manifest goes up verbatim: serviceAccountName (RBAC to spawn
+    # worker/PS pods) and the MY_POD_IP fieldRef must survive.
+    client.create_pod_from_manifest(manifest)
+    logger.info("Submitted master pod for job %s", job_args.job_name)
+    return 0
+
+
+# ---------- zoo ----------
+
+_ZOO_TEMPLATE = '''"""Model definition for elasticdl_tpu.
+
+Export the spec contract: custom_model / loss / optimizer / feed
+(+ optional eval_metrics_fn / callbacks / embedding_inputs).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from elasticdl_tpu.data.example import batch_examples
+from elasticdl_tpu.ops import optimizers
+
+
+class Model(nn.Module):
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = nn.Dense(64)(x)
+        x = nn.relu(x)
+        return nn.Dense(1)(x)
+
+
+def custom_model():
+    return Model()
+
+
+def loss(labels, predictions):
+    return jnp.mean((predictions.reshape(-1) - labels.reshape(-1)) ** 2)
+
+
+def optimizer():
+    return optimizers.sgd(learning_rate=0.1)
+
+
+def feed(records, mode, metadata):
+    batch = batch_examples(records)
+    return batch["x"], batch.get("y")
+'''
+
+
+def _zoo_init(args):
+    os.makedirs(args.path, exist_ok=True)
+    target = os.path.join(args.path, f"{args.name}.py")
+    if os.path.exists(target) and not args.force:
+        logger.error("%s already exists (use --force)", target)
+        return 1
+    with open(target, "w") as f:
+        f.write(_ZOO_TEMPLATE)
+    logger.info("Created model definition scaffold at %s", target)
+    return 0
+
+
+def _zoo_list(args):
+    import elasticdl_tpu.models as zoo
+
+    zoo_dir = os.path.dirname(zoo.__file__)
+    for entry in sorted(os.listdir(zoo_dir)):
+        path = os.path.join(zoo_dir, entry)
+        if os.path.isdir(path) and not entry.startswith("__"):
+            print(entry)
+    return 0
+
+
+def _zoo_build(args):
+    """Copy a model zoo dir next to a Dockerfile for image builds (the
+    docker SDK is optional; this prints the build command instead of
+    shelling out when docker is unavailable)."""
+    os.makedirs(args.build_dir, exist_ok=True)
+    dest = os.path.join(
+        args.build_dir, os.path.basename(os.path.normpath(args.path))
+    )
+    if os.path.exists(dest):
+        shutil.rmtree(dest)
+    shutil.copytree(args.path, dest)
+    dockerfile = os.path.join(args.build_dir, "Dockerfile")
+    with open(dockerfile, "w") as f:
+        f.write(
+            f"FROM {args.base_image}\n"
+            f"COPY {os.path.basename(dest)} /model_zoo/"
+            f"{os.path.basename(dest)}\n"
+            "ENV PYTHONPATH=/model_zoo\n"
+        )
+    print(
+        f"docker build -t {args.image} {args.build_dir}",
+    )
+    return 0
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    top = argparse.ArgumentParser(
+        "edl", description="elastic TPU deep learning"
+    )
+    top.add_argument(
+        "command",
+        choices=["train", "evaluate", "predict", "zoo"],
+    )
+    ns, rest = top.parse_known_args(argv)
+
+    if ns.command == "zoo":
+        zoo = argparse.ArgumentParser("edl zoo")
+        sub = zoo.add_subparsers(dest="zoo_command", required=True)
+        init_p = sub.add_parser("init")
+        init_p.add_argument("--path", default=".")
+        init_p.add_argument("--name", default="my_model")
+        init_p.add_argument("--force", action="store_true")
+        init_p.set_defaults(func=_zoo_init)
+        list_p = sub.add_parser("list")
+        list_p.set_defaults(func=_zoo_list)
+        build_p = sub.add_parser("build")
+        build_p.add_argument("--path", required=True)
+        build_p.add_argument("--build_dir", default="./build")
+        build_p.add_argument("--image", default="elasticdl_tpu:latest")
+        build_p.add_argument(
+            "--base_image", default="python:3.12-slim"
+        )
+        build_p.set_defaults(func=_zoo_build)
+        zargs = zoo.parse_args(rest)
+        return zargs.func(zargs)
+
+    parser = _job_parser(ns.command)
+    job_args = parser.parse_args(rest)
+    # evaluate/predict are the train command with the matching data flags
+    # (the reference routes them the same way, main.py:28-88).
+    if ns.command == "evaluate" and not job_args.validation_data:
+        parser.error("evaluate requires --validation_data")
+    if ns.command == "predict" and not job_args.prediction_data:
+        parser.error("predict requires --prediction_data")
+    if ns.command in ("evaluate", "predict"):
+        job_args.training_data = ""
+    return _submit(job_args, rest)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,199 @@
+"""Argument definitions for master / worker / PS processes and the CLI.
+
+Reference counterparts: /root/reference/elasticdl_client/common/args.py
+(~60 flags over zoo/common/train/evaluate/predict groups) and
+elasticdl/python/common/args.py:154-164 (validation: async => grads_to_wait
+is 1). Three-stage relay kept: CLI flags -> master argv -> worker/PS argv
+(build_arguments_from_parsed_result)."""
+
+import argparse
+import os
+
+from elasticdl_tpu.common.constants import DistributionStrategy
+
+
+def add_common_arguments(parser):
+    parser.add_argument("--job_name", default="edl-job")
+    parser.add_argument(
+        "--model_zoo",
+        default="",
+        help="directory prepended to sys.path before importing model_def",
+    )
+    parser.add_argument(
+        "--model_def",
+        required=True,
+        help="dotted module path or .py file exporting the model spec "
+        "(custom_model/loss/optimizer/feed[/eval_metrics_fn])",
+    )
+    parser.add_argument(
+        "--distribution_strategy",
+        default=DistributionStrategy.ALLREDUCE,
+        choices=[
+            DistributionStrategy.LOCAL,
+            DistributionStrategy.ALLREDUCE,
+            DistributionStrategy.PARAMETER_SERVER,
+        ],
+    )
+    parser.add_argument("--minibatch_size", type=int, default=64)
+    parser.add_argument("--log_loss_steps", type=int, default=100)
+    parser.add_argument("--seed", type=int, default=0)
+
+
+def add_data_arguments(parser):
+    parser.add_argument("--training_data", default="")
+    parser.add_argument("--validation_data", default="")
+    parser.add_argument("--prediction_data", default="")
+    parser.add_argument("--records_per_task", type=int, default=1024)
+    parser.add_argument("--num_epochs", type=int, default=1)
+    parser.add_argument(
+        "--shuffle_shards", action="store_true", default=True
+    )
+    parser.add_argument(
+        "--no_shuffle_shards", dest="shuffle_shards", action="store_false"
+    )
+
+
+def add_train_arguments(parser):
+    parser.add_argument(
+        "--evaluation_steps",
+        type=int,
+        default=0,
+        help="evaluate every N model versions (0: once per epoch-ish "
+        "report)",
+    )
+    parser.add_argument("--checkpoint_dir", default="")
+    parser.add_argument("--checkpoint_steps", type=int, default=0)
+    parser.add_argument("--keep_checkpoint_max", type=int, default=3)
+    parser.add_argument(
+        "--checkpoint_dir_for_init",
+        default="",
+        help="restore PS state from this checkpoint dir at boot",
+    )
+    parser.add_argument("--output", default="", help="model export path")
+
+
+def add_cluster_arguments(parser):
+    parser.add_argument("--num_workers", type=int, default=0)
+    parser.add_argument("--num_ps", type=int, default=0)
+    parser.add_argument(
+        "--instance_backend",
+        default="local_process",
+        choices=["local_process", "k8s", "none"],
+        help="none: workers/PS are launched externally and dial in",
+    )
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument("--image_name", default="")
+    parser.add_argument("--worker_resources", default="")
+    parser.add_argument("--ps_resources", default="")
+    parser.add_argument("--max_relaunches", type=int, default=3)
+    parser.add_argument("--master_port", type=int, default=50001)
+    parser.add_argument(
+        "--coordinator_port",
+        type=int,
+        default=51000,
+        help="jax.distributed coordination-service port on rank 0",
+    )
+    parser.add_argument(
+        "--task_timeout_check_seconds", type=float, default=30.0
+    )
+    parser.add_argument(
+        "--worker_liveness_timeout_seconds", type=float, default=180.0
+    )
+
+
+def add_ps_arguments(parser):
+    parser.add_argument("--ps_id", type=int, default=0)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--use_async", action="store_true", default=True)
+    parser.add_argument(
+        "--use_sync", dest="use_async", action="store_false"
+    )
+    parser.add_argument("--grads_to_wait", type=int, default=1)
+    parser.add_argument("--sync_version_tolerance", type=int, default=0)
+    parser.add_argument(
+        "--lr_staleness_modulation", action="store_true", default=False
+    )
+
+
+def validate_args(args):
+    """Cross-flag validation (reference elasticdl/python/common/
+    args.py:154-164)."""
+    if getattr(args, "use_async", True) and getattr(
+        args, "grads_to_wait", 1
+    ) > 1:
+        raise ValueError("async SGD requires grads_to_wait == 1")
+    if (
+        getattr(args, "distribution_strategy", None)
+        == DistributionStrategy.PARAMETER_SERVER
+        and getattr(args, "num_ps", 0) < 1
+        and getattr(args, "instance_backend", "") != "none"
+    ):
+        raise ValueError("ParameterServerStrategy requires --num_ps >= 1")
+
+
+def build_arguments_from_parsed_result(args, filter_args=None):
+    """argparse Namespace -> argv list, for relaying flags into spawned
+    processes (reference args.py:521-543)."""
+    items = vars(args)
+    argv = []
+    for key, value in items.items():
+        if filter_args and key not in filter_args:
+            continue
+        if value is None or value == "":
+            continue
+        if isinstance(value, bool):
+            if key == "use_async":
+                argv.append("--use_async" if value else "--use_sync")
+            elif value:
+                argv.append(f"--{key}")
+            continue
+        argv.extend([f"--{key}", str(value)])
+    return argv
+
+
+def master_parser():
+    p = argparse.ArgumentParser("elasticdl_tpu master")
+    add_common_arguments(p)
+    add_data_arguments(p)
+    add_train_arguments(p)
+    add_cluster_arguments(p)
+    add_ps_arguments(p)
+    return p
+
+
+def worker_parser():
+    p = argparse.ArgumentParser("elasticdl_tpu worker")
+    add_common_arguments(p)
+    add_data_arguments(p)
+    add_train_arguments(p)
+    p.add_argument("--worker_id", type=int, required=True)
+    p.add_argument("--master_addr", required=True)
+    p.add_argument("--ps_addrs", default="", help="comma-separated")
+    p.add_argument(
+        "--worker_host",
+        default=os.environ.get("MY_POD_IP", "127.0.0.1"),
+        help="address other workers can reach this worker on (defaults to "
+        "$MY_POD_IP, injected into every k8s replica pod)",
+    )
+    p.add_argument(
+        "--job_type",
+        default="training_only",
+        choices=[
+            "training_only",
+            "training_with_evaluation",
+            "evaluation_only",
+            "prediction_only",
+        ],
+    )
+    p.add_argument("--multi_host", action="store_true", default=False)
+    return p
+
+
+def ps_parser():
+    p = argparse.ArgumentParser("elasticdl_tpu pserver")
+    add_common_arguments(p)
+    add_train_arguments(p)
+    add_ps_arguments(p)
+    p.add_argument("--num_ps", type=int, default=1)
+    p.add_argument("--master_addr", default="")
+    return p

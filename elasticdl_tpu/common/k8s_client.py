@@ -1,0 +1,162 @@
+"""Thin Kubernetes client for pod create/watch/delete.
+
+Reference counterpart: /root/reference/elasticdl/python/common/
+k8s_client.py:40-300 and the client-package base
+(elasticdl_client/common/k8s_client.py:50-242). Import-gated: the
+`kubernetes` package is an optional dependency — everything cluster-facing
+lives behind this module so the rest of the framework imports cleanly
+without it.
+"""
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("common.k8s_client")
+
+try:  # pragma: no cover - exercised only on a real cluster
+    from kubernetes import client as k8s_api
+    from kubernetes import config as k8s_config
+    from kubernetes import watch as k8s_watch
+
+    K8S_AVAILABLE = True
+except ImportError:  # pragma: no cover
+    k8s_api = k8s_config = k8s_watch = None
+    K8S_AVAILABLE = False
+
+ELASTICDL_JOB_KEY = "elasticdl-job-name"
+ELASTICDL_REPLICA_TYPE_KEY = "elasticdl-replica-type"
+ELASTICDL_REPLICA_INDEX_KEY = "elasticdl-replica-index"
+
+
+def require_k8s():
+    if not K8S_AVAILABLE:
+        raise RuntimeError(
+            "the 'kubernetes' python package is not installed; "
+            "K8s-backed instance management is unavailable "
+            "(use the local-process backend or install kubernetes)"
+        )
+
+
+class Client:  # pragma: no cover - exercised only on a real cluster
+    """Pod lifecycle for one job's master/worker/PS replicas."""
+
+    def __init__(self, namespace, job_name, image_name, event_callback=None):
+        require_k8s()
+        try:
+            k8s_config.load_incluster_config()
+        except Exception:
+            k8s_config.load_kube_config()
+        self.namespace = namespace
+        self.job_name = job_name
+        self.image_name = image_name
+        self._v1 = k8s_api.CoreV1Api()
+        self._event_cb = event_callback
+        if event_callback:
+            import threading
+
+            threading.Thread(target=self._watch, daemon=True).start()
+
+    def _watch(self):
+        w = k8s_watch.Watch()
+        while True:
+            try:
+                for event in w.stream(
+                    self._v1.list_namespaced_pod,
+                    self.namespace,
+                    label_selector=f"{ELASTICDL_JOB_KEY}={self.job_name}",
+                ):
+                    self._event_cb(event)
+            except Exception:
+                logger.warning("k8s watch stream reset", exc_info=True)
+
+    def pod_name(self, replica_type, replica_index):
+        return (
+            f"elasticdl-{self.job_name}-{replica_type}-{replica_index}"
+        )
+
+    def create_pod(
+        self,
+        replica_type,
+        replica_index,
+        command,
+        resource_requests=None,
+        resource_limits=None,
+        priority_class=None,
+        envs=None,
+        restart_policy="Never",
+    ):
+        env = [
+            k8s_api.V1EnvVar(name=k, value=v)
+            for k, v in (envs or {}).items()
+        ]
+        # Every replica learns its own routable address (workers advertise
+        # it as their comm host; the master binds on it).
+        env.append(
+            k8s_api.V1EnvVar(
+                name="MY_POD_IP",
+                value_from=k8s_api.V1EnvVarSource(
+                    field_ref=k8s_api.V1ObjectFieldSelector(
+                        field_path="status.podIP"
+                    )
+                ),
+            )
+        )
+        container = k8s_api.V1Container(
+            name="main",
+            image=self.image_name,
+            command=command,
+            resources=k8s_api.V1ResourceRequirements(
+                requests=resource_requests, limits=resource_limits
+            ),
+            env=env,
+        )
+        pod = k8s_api.V1Pod(
+            metadata=k8s_api.V1ObjectMeta(
+                name=self.pod_name(replica_type, replica_index),
+                labels={
+                    ELASTICDL_JOB_KEY: self.job_name,
+                    ELASTICDL_REPLICA_TYPE_KEY: replica_type,
+                    ELASTICDL_REPLICA_INDEX_KEY: str(replica_index),
+                },
+            ),
+            spec=k8s_api.V1PodSpec(
+                containers=[container],
+                restart_policy=restart_policy,
+                priority_class_name=priority_class,
+            ),
+        )
+        return self._v1.create_namespaced_pod(self.namespace, pod)
+
+    def create_pod_from_manifest(self, manifest):
+        """Create a pod from a raw manifest dict (used for the master pod so
+        serviceAccountName/env fieldRefs survive verbatim)."""
+        return self._v1.create_namespaced_pod(self.namespace, manifest)
+
+    def create_service(self, name, port, replica_type, replica_index):
+        """Stable DNS name for a replica (PS pods get one each, reference
+        common/k8s_client.py service creation)."""
+        service = k8s_api.V1Service(
+            metadata=k8s_api.V1ObjectMeta(
+                name=name,
+                labels={ELASTICDL_JOB_KEY: self.job_name},
+            ),
+            spec=k8s_api.V1ServiceSpec(
+                selector={
+                    ELASTICDL_JOB_KEY: self.job_name,
+                    ELASTICDL_REPLICA_TYPE_KEY: replica_type,
+                    ELASTICDL_REPLICA_INDEX_KEY: str(replica_index),
+                },
+                ports=[k8s_api.V1ServicePort(port=port)],
+            ),
+        )
+        return self._v1.create_namespaced_service(self.namespace, service)
+
+    def delete_pod(self, replica_type, replica_index):
+        self._v1.delete_namespaced_pod(
+            self.pod_name(replica_type, replica_index), self.namespace
+        )
+
+    def get_pod_phase(self, replica_type, replica_index):
+        pod = self._v1.read_namespaced_pod(
+            self.pod_name(replica_type, replica_index), self.namespace
+        )
+        return pod.status.phase

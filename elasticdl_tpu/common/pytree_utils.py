@@ -1,0 +1,33 @@
+"""Pytree <-> wire-name mapping shared by the PS client path, worker
+checkpoints, and state broadcast."""
+
+import jax
+
+
+def _path_name(path):
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+    )
+
+
+def flatten_params(params):
+    """params pytree -> ({wire_name: leaf}, [names in leaf order]). Names
+    are '/'-joined dict paths ('Dense_0/kernel'), stable across workers."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    named = {}
+    names = []
+    for path, leaf in flat:
+        name = _path_name(path)
+        named[name] = leaf
+        names.append(name)
+    return named, names
+
+
+def unflatten_like(params, named):
+    """Rebuild a params-shaped pytree taking leaves from `named` by wire
+    name (missing names keep the existing leaf)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    leaves = []
+    for path, leaf in flat:
+        leaves.append(named.get(_path_name(path), leaf))
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,0 +1,66 @@
+"""Worker-side checkpoint save/restore (local & AllReduce strategies).
+
+The PS strategy checkpoints server-side (ps/checkpoint.py, the reference's
+PS-side scheme); for strategies whose state lives in the worker this module
+saves the trainer's (variables, version) as an .npz of wire-named arrays —
+the analog of the reference's CheckpointSaver + SavedModel export hand-off
+(/root/reference/elasticdl/python/common/save_utils.py:151-282,
+master/callbacks.py:38-66).
+"""
+
+import os
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.pytree_utils import flatten_params, unflatten_like
+
+logger = get_logger("common.save_utils")
+
+
+def _normalize(path):
+    """np.savez appends '.npz' itself; normalize so the logged path, the
+    saved file, and a later restore all agree."""
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def save_trainer_checkpoint(trainer, path):
+    exported = trainer.export_variables()
+    if exported is None or exported.get("variables") is None:
+        # E.g. a relaunched worker that only picked up the train-end export
+        # task: failing here reports the task back to the master, which
+        # re-queues it for a worker that actually holds trained state.
+        raise ValueError("trainer has no exportable state")
+    path = _normalize(path)
+    named, _ = flatten_params(exported["variables"])
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    np.savez(
+        path[: -len(".npz")],
+        __version__=np.int64(exported["version"]),
+        **{name: np.asarray(leaf) for name, leaf in named.items()},
+    )
+    logger.info("Saved model checkpoint to %s", path)
+
+
+def restore_trainer_checkpoint(trainer, path):
+    """Restore into an ALREADY-INITIALIZED trainer (variables define the
+    pytree to fill)."""
+    with np.load(_normalize(path)) as data:
+        named = {k: data[k] for k in data.files if k != "__version__"}
+        version = int(data["__version__"])
+    exported = trainer.export_variables()
+    exported["variables"] = unflatten_like(exported["variables"], named)
+    exported["version"] = version
+    trainer.restore_variables(exported)
+    logger.info("Restored model checkpoint from %s (version %d)", path, version)
+
+
+class ExportModelCallback:
+    """Train-end callback writing the final model (reference
+    SavedModelExporter.on_train_end, master/callbacks.py:38-66)."""
+
+    def __init__(self, output_path):
+        self._path = output_path
+
+    def on_train_end(self, trainer):
+        save_trainer_checkpoint(trainer, self._path)

@@ -122,6 +122,46 @@ class InMemoryReader(AbstractDataReader):
         return {self._shard_name: (0, len(self._records))}
 
 
+class CompositeReader(AbstractDataReader):
+    """Routes tasks to the sub-reader owning the task's shard.
+
+    A worker doing training + interleaved evaluation holds one reader, but
+    training and validation data are distinct origins: the master names
+    shards after each origin's own shard keys, so routing by shard_name
+    keeps evaluation tasks reading validation rows (a single-origin reader
+    that ignores shard_name would silently evaluate on training data)."""
+
+    def __init__(self, readers, **kwargs):
+        super().__init__(**kwargs)
+        self._readers = list(readers)
+        self._shard_to_reader = {}
+        for reader in self._readers:
+            for shard_name in reader.create_shards():
+                self._shard_to_reader[shard_name] = reader
+
+    def _reader_for(self, shard_name):
+        reader = self._shard_to_reader.get(shard_name)
+        if reader is None:
+            raise ValueError(
+                f"no reader owns shard {shard_name!r}; known: "
+                f"{sorted(self._shard_to_reader)}"
+            )
+        return reader
+
+    def read_records(self, task):
+        yield from self._reader_for(task.shard_name).read_records(task)
+
+    def create_shards(self):
+        shards = {}
+        for reader in self._readers:
+            shards.update(reader.create_shards())
+        return shards
+
+    @property
+    def metadata(self):
+        return self._readers[0].metadata
+
+
 def create_data_reader(data_origin, records_per_task=None, **kwargs):
     """Factory sniffing the origin type (reference
     data_reader_factory.py:23-73)."""

@@ -1,0 +1,190 @@
+"""Kubernetes-backed elastic instance manager.
+
+Reference counterpart: /root/reference/elasticdl/python/master/
+k8s_instance_manager.py:53-439. Pod phase accounting from the watch stream;
+relaunch on deletion or exit 137 that is not an OOM kill (= preemption,
+k8s_instance_manager.py:327-348,391-404); task recovery + membership update
+on worker failure. Import-gated via common/k8s_client; exercised only by
+env-gated cluster tests (K8S_TESTS=true), mirroring the reference's gating
+(k8s_instance_manager_test.py:25).
+"""
+
+import threading
+
+from elasticdl_tpu.common import k8s_client
+from elasticdl_tpu.common.constants import PodStatus
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.master.instance_manager import DEFAULT_MAX_RELAUNCHES
+
+logger = get_logger("master.k8s_instance_manager")
+
+
+class K8sInstanceManager:
+    def __init__(
+        self,
+        namespace,
+        job_name,
+        image_name,
+        command_for,
+        num_workers=0,
+        num_ps=0,
+        task_dispatcher=None,
+        membership=None,
+        worker_resources=None,
+        ps_resources=None,
+        worker_priority=None,
+        max_relaunches=DEFAULT_MAX_RELAUNCHES,
+        envs=None,
+        ps_service_port=50002,
+    ):
+        k8s_client.require_k8s()
+        self._command_for = command_for
+        self._num_workers = num_workers
+        self._num_ps = num_ps
+        self._task_d = task_dispatcher
+        self._membership = membership
+        self._worker_resources = worker_resources
+        self._ps_resources = ps_resources
+        self._worker_priority = worker_priority
+        self._max_relaunches = max_relaunches
+        self._envs = envs or {}
+        self._ps_service_port = ps_service_port
+        self._lock = threading.Lock()
+        self._statuses = {}  # (kind, id) -> PodStatus
+        self._relaunches = {}  # (kind, id) -> count
+        self._client = k8s_client.Client(
+            namespace, job_name, image_name, event_callback=self._event_cb
+        )
+
+    # ---------- lifecycle ----------
+
+    def start_parameter_servers(self):
+        for ps_id in range(self._num_ps):
+            self._start("ps", ps_id)
+
+    def start_workers(self):
+        for worker_id in range(self._num_workers):
+            self._start("worker", worker_id)
+
+    def _start(self, kind, instance_id):
+        self._client.create_pod(
+            kind,
+            instance_id,
+            self._command_for(kind, instance_id),
+            resource_requests=(
+                self._ps_resources if kind == "ps" else self._worker_resources
+            ),
+            priority_class=(
+                self._worker_priority if kind == "worker" else None
+            ),
+            envs=self._envs,
+        )
+        if kind == "ps":
+            # Stable service name so a relaunched PS keeps its address and
+            # workers re-seed it transparently (reference
+            # k8s_instance_manager.py:399-404).
+            with self._lock:
+                first = (kind, instance_id) not in self._statuses
+            if first:
+                try:
+                    self._client.create_service(
+                        f"{self._client.job_name}-ps-{instance_id}",
+                        self._ps_service_port,
+                        kind,
+                        instance_id,
+                    )
+                except Exception:
+                    logger.warning(
+                        "PS service creation failed (may already exist)",
+                        exc_info=True,
+                    )
+        with self._lock:
+            self._statuses[(kind, instance_id)] = PodStatus.PENDING
+
+    def stop(self):
+        with self._lock:
+            keys = list(self._statuses)
+        for kind, instance_id in keys:
+            try:
+                self._client.delete_pod(kind, instance_id)
+            except Exception:
+                pass
+
+    # ---------- watch-event state machine ----------
+
+    def _event_cb(self, event):
+        pod = event["object"]
+        labels = pod.metadata.labels or {}
+        kind = labels.get(k8s_client.ELASTICDL_REPLICA_TYPE_KEY)
+        if kind not in ("worker", "ps"):
+            return
+        instance_id = int(
+            labels.get(k8s_client.ELASTICDL_REPLICA_INDEX_KEY, -1)
+        )
+        phase = pod.status.phase
+        deleted = event["type"] == "DELETED"
+        with self._lock:
+            prev = self._statuses.get((kind, instance_id))
+        if phase == "Running" and prev != PodStatus.RUNNING:
+            with self._lock:
+                self._statuses[(kind, instance_id)] = PodStatus.RUNNING
+            return
+        if phase == "Succeeded":
+            with self._lock:
+                self._statuses[(kind, instance_id)] = PodStatus.SUCCEEDED
+            if kind == "worker" and self._membership is not None:
+                self._membership.remove_worker(instance_id)
+            return
+        if deleted or phase == "Failed":
+            relaunch = deleted or self._is_preempted(pod)
+            self._on_failure(kind, instance_id, relaunch)
+
+    @staticmethod
+    def _is_preempted(pod):
+        """Exit 137 that is NOT an OOMKill = preemption/eviction -> relaunch
+        (the reference's policy, k8s_instance_manager.py:327-348)."""
+        statuses = (pod.status.container_statuses or [])
+        for cs in statuses:
+            term = cs.state and cs.state.terminated
+            if term and term.exit_code == 137 and term.reason != "OOMKilled":
+                return True
+        return False
+
+    def _on_failure(self, kind, instance_id, relaunch):
+        logger.warning(
+            "%s %d failed (relaunch=%s)", kind, instance_id, relaunch
+        )
+        if kind == "worker":
+            if self._task_d is not None:
+                self._task_d.recover_tasks(instance_id)
+            if self._membership is not None:
+                self._membership.remove_worker(instance_id)
+        with self._lock:
+            count = self._relaunches.get((kind, instance_id), 0)
+            can_relaunch = relaunch and count < self._max_relaunches
+            if can_relaunch:
+                self._relaunches[(kind, instance_id)] = count + 1
+            else:
+                self._statuses[(kind, instance_id)] = PodStatus.FAILED
+        if can_relaunch:
+            # PS keeps its id and service address so workers re-seed it
+            # transparently (reference k8s_instance_manager.py:399-404).
+            self._start(kind, instance_id)
+
+    # ---------- status ----------
+
+    def all_workers_failed(self):
+        with self._lock:
+            workers = [
+                s for (k, _), s in self._statuses.items() if k == "worker"
+            ]
+        return bool(workers) and all(s == PodStatus.FAILED for s in workers)
+
+    def all_workers_done(self):
+        with self._lock:
+            workers = [
+                s for (k, _), s in self._statuses.items() if k == "worker"
+            ]
+        return bool(workers) and all(
+            s in (PodStatus.SUCCEEDED, PodStatus.FAILED) for s in workers
+        )

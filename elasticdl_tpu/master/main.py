@@ -1,0 +1,19 @@
+"""`python -m elasticdl_tpu.master.main` — master process entrypoint
+(reference /root/reference/elasticdl/python/master/main.py)."""
+
+import sys
+
+from elasticdl_tpu.common.args import master_parser, validate_args
+from elasticdl_tpu.master.master import Master
+
+
+def main(argv=None):
+    args = master_parser().parse_args(argv)
+    validate_args(args)
+    master = Master(args)
+    master.prepare()
+    return master.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,296 @@
+"""The master orchestrator: control plane of one elastic training job.
+
+Reference counterpart: /root/reference/elasticdl/python/master/
+master.py:97-509. Builds the task dispatcher from the dataset shards, serves
+the Master gRPC service, spawns PS + worker instances through an instance
+manager backend, and runs the poll loop: job completion, all-workers-failed
+abort, the task-timeout watchdog (a task running > 3x the rolling mean
+completion time gets its worker's tasks recovered and its membership entry
+dropped, master.py:487-509), and the worker-liveness timeout
+(servicer.py:93-94,131-148).
+"""
+
+import os
+import sys
+import time
+
+from elasticdl_tpu.common import rpc
+from elasticdl_tpu.common.args import build_arguments_from_parsed_result
+from elasticdl_tpu.common.constants import DistributionStrategy
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.model_utils import get_model_spec
+from elasticdl_tpu.data.reader import create_data_reader
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.instance_manager import (
+    LocalProcessInstanceManager,
+)
+from elasticdl_tpu.master.membership import MembershipManager
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+logger = get_logger("master.master")
+
+_COMMON_RELAY_ARGS = [
+    "job_name",
+    "model_zoo",
+    "model_def",
+    "distribution_strategy",
+    "minibatch_size",
+    "log_loss_steps",
+    "seed",
+    "training_data",
+    "validation_data",
+    "prediction_data",
+    "records_per_task",
+    "num_epochs",
+]
+
+
+class Master:
+    def __init__(self, args):
+        self.args = args
+        if args.model_zoo:
+            sys.path.insert(0, args.model_zoo)
+        self.spec = get_model_spec(args.model_def)
+
+        # --- data shards -> task dispatcher (reference master.py:61-94) ---
+        reader_factory = self.spec.create_data_reader or create_data_reader
+        training_shards = (
+            reader_factory(args.training_data).create_shards()
+            if args.training_data
+            else {}
+        )
+        evaluation_shards = (
+            reader_factory(args.validation_data).create_shards()
+            if args.validation_data
+            else {}
+        )
+        prediction_shards = (
+            reader_factory(args.prediction_data).create_shards()
+            if args.prediction_data
+            else {}
+        )
+        self.task_d = TaskDispatcher(
+            training_shards,
+            evaluation_shards,
+            prediction_shards,
+            records_per_task=args.records_per_task,
+            num_epochs=args.num_epochs,
+            shuffle=args.shuffle_shards,
+            seed=args.seed,
+        )
+
+        self.evaluation_service = None
+        if evaluation_shards:
+            self.evaluation_service = EvaluationService(
+                self.task_d,
+                self.spec.build_metrics
+                if self.spec.eval_metrics_fn
+                else dict,
+                eval_steps=args.evaluation_steps,
+            )
+
+        self.membership = (
+            MembershipManager(coordinator_port=args.coordinator_port)
+            if args.distribution_strategy == DistributionStrategy.ALLREDUCE
+            else None
+        )
+        if args.output and training_shards:
+            # Arm the final export task (reference: SavedModel export via a
+            # train-end callback task, master/callbacks.py:38-66).
+            self.task_d.enable_train_end_task()
+        self.servicer = MasterServicer(
+            self.task_d, self.evaluation_service, self.membership
+        )
+        self._server = None
+        self.port = None
+        self.instance_manager = self._build_instance_manager(args)
+
+    # ---------- instance manager wiring ----------
+
+    def _build_instance_manager(self, args):
+        if args.instance_backend == "none" or (
+            args.num_workers == 0 and args.num_ps == 0
+        ):
+            return None
+        if args.instance_backend == "local_process":
+            return LocalProcessInstanceManager(
+                self._command_for,
+                num_workers=args.num_workers,
+                num_ps=args.num_ps,
+                task_dispatcher=self.task_d,
+                membership=self.membership,
+                max_relaunches=args.max_relaunches,
+            )
+        if args.instance_backend == "k8s":
+            from elasticdl_tpu.master.k8s_instance_manager import (
+                K8sInstanceManager,
+            )
+
+            return K8sInstanceManager(
+                args.namespace,
+                args.job_name,
+                args.image_name,
+                self._command_for,
+                num_workers=args.num_workers,
+                num_ps=args.num_ps,
+                task_dispatcher=self.task_d,
+                membership=self.membership,
+                max_relaunches=args.max_relaunches,
+            )
+        raise ValueError(f"unknown backend {args.instance_backend!r}")
+
+    def _master_addr(self):
+        host = os.environ.get("MY_POD_IP", "127.0.0.1")
+        return f"{host}:{self.port}"
+
+    PS_SERVICE_PORT = 50002
+
+    def _ps_addr(self, ps_id):
+        # Local backend: PS picks port master_port+1+ps_id on this host;
+        # k8s backend: stable per-PS service names (created by the k8s
+        # instance manager) on PS_SERVICE_PORT.
+        if self.args.instance_backend == "k8s":
+            return (
+                f"{self.args.job_name}-ps-{ps_id}:{self.PS_SERVICE_PORT}"
+            )
+        return f"127.0.0.1:{self.args.master_port + 1 + ps_id}"
+
+    def ps_addrs(self):
+        return ",".join(
+            self._ps_addr(i) for i in range(self.args.num_ps)
+        )
+
+    def _command_for(self, kind, instance_id):
+        """argv for a spawned instance (reference master.py:424-476 builds
+        worker/PS pod command lines the same way)."""
+        relay = build_arguments_from_parsed_result(
+            self.args, filter_args=_COMMON_RELAY_ARGS
+        )
+        if kind == "worker":
+            argv = [
+                sys.executable,
+                "-m",
+                "elasticdl_tpu.worker.main",
+                "--worker_id",
+                str(instance_id),
+                "--master_addr",
+                self._master_addr(),
+            ]
+            if self.args.num_ps:
+                argv += ["--ps_addrs", self.ps_addrs()]
+            if self.args.training_data:
+                if self.args.validation_data:
+                    argv += ["--job_type", "training_with_evaluation"]
+            elif self.args.validation_data:
+                argv += ["--job_type", "evaluation_only"]
+            elif self.args.prediction_data:
+                argv += ["--job_type", "prediction_only"]
+            for flag in ("output", "checkpoint_dir_for_init"):
+                value = getattr(self.args, flag, "")
+                if value:
+                    argv += [f"--{flag}", str(value)]
+            return argv + relay
+        if kind == "ps":
+            ps_port = int(self._ps_addr(instance_id).rsplit(":", 1)[1])
+            argv = [
+                sys.executable,
+                "-m",
+                "elasticdl_tpu.ps.main",
+                "--ps_id",
+                str(instance_id),
+                "--num_ps",
+                str(self.args.num_ps),
+                "--port",
+                str(ps_port),
+                "--master_addr",
+                self._master_addr(),
+            ]
+            for flag in (
+                "checkpoint_dir",
+                "checkpoint_steps",
+                "keep_checkpoint_max",
+                "checkpoint_dir_for_init",
+                "grads_to_wait",
+                "sync_version_tolerance",
+            ):
+                value = getattr(self.args, flag, None)
+                if value:
+                    argv += [f"--{flag}", str(value)]
+            if not self.args.use_async:
+                argv += ["--use_sync"]
+            if self.args.lr_staleness_modulation:
+                argv += ["--lr_staleness_modulation"]
+            return argv + relay
+        raise ValueError(kind)
+
+    # ---------- lifecycle ----------
+
+    def prepare(self):
+        self._server, self.port = rpc.serve(
+            self.servicer, rpc.MASTER_SERVICE, port=self.args.master_port
+        )
+        logger.info("Master serving on port %d", self.port)
+        if self.instance_manager is not None:
+            if self.args.num_ps:
+                self.instance_manager.start_parameter_servers()
+            self.instance_manager.start_workers()
+
+    def run(self, poll_seconds=None):
+        """Poll until done/failed (reference master.py:238-263). Returns the
+        process exit code."""
+        poll = poll_seconds or min(
+            5.0, self.args.task_timeout_check_seconds
+        )
+        last_watchdog = time.time()
+        try:
+            while True:
+                if self.task_d.finished():
+                    logger.info("All tasks complete; job done")
+                    return 1 if self.task_d.job_failed else 0
+                if self.task_d.job_failed:
+                    logger.error("Job failed (task retries exhausted)")
+                    return 1
+                if (
+                    self.instance_manager is not None
+                    and self.instance_manager.all_workers_failed()
+                ):
+                    logger.error("All workers failed; aborting job")
+                    return 1
+                now = time.time()
+                if (
+                    now - last_watchdog
+                    >= self.args.task_timeout_check_seconds
+                ):
+                    last_watchdog = now
+                    self._run_watchdog()
+                time.sleep(poll)
+        finally:
+            self.stop()
+
+    def _run_watchdog(self):
+        """Task-timeout + liveness watchdog (reference master.py:487-509)."""
+        slow = self.task_d.doing_tasks_over_timeout()
+        deadline = (
+            time.time() - self.args.worker_liveness_timeout_seconds
+        )
+        silent = {
+            wid
+            for wid, ts in self.servicer.worker_liveness.items()
+            if ts < deadline
+        }
+        for worker_id in slow | silent:
+            why = "slow" if worker_id in slow else "silent"
+            logger.warning(
+                "Watchdog: recovering tasks of %s worker %d",
+                why,
+                worker_id,
+            )
+            self.task_d.recover_tasks(worker_id)
+            self.servicer.worker_liveness.pop(worker_id, None)
+
+    def stop(self):
+        if self.instance_manager is not None:
+            self.instance_manager.stop()
+        if self._server is not None:
+            self._server.stop(2)

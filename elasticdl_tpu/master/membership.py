@@ -19,8 +19,9 @@ logger = get_logger("master.membership")
 
 class MembershipManager:
     def __init__(self, coordinator_port=51000):
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
         self._hosts = []  # sorted by join order (pod start time analog)
+        self._id_to_host = {}  # worker_id -> registered host
         self._group_id = 0
         self._coordinator_port = coordinator_port
 
@@ -51,6 +52,26 @@ class MembershipManager:
                     len(self._hosts),
                 )
             return self._group_id
+
+    def register(self, worker_id, host):
+        """Join + remember worker_id -> host, so the instance manager can
+        evict by id on failure (hosts alone are ambiguous: every local
+        worker shares one IP and only differs in ephemeral port)."""
+        with self._lock:
+            old = self._id_to_host.get(worker_id)
+            if old == host:
+                return self._group_id
+            self._id_to_host[worker_id] = host
+        if old is not None:
+            self.remove_worker_host(old)
+        return self.add_worker_host(host)
+
+    def remove_worker(self, worker_id):
+        with self._lock:
+            host = self._id_to_host.pop(worker_id, None)
+        if host is not None:
+            return self.remove_worker_host(host)
+        return self.group_id
 
     def remove_worker_host(self, host):
         with self._lock:

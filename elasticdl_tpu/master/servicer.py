@@ -107,5 +107,5 @@ class MasterServicer:
     def report_worker_liveness(self, request, context):
         self._touch(request.worker_id)
         if self._membership is not None and request.host:
-            self._membership.add_worker_host(request.host)
+            self._membership.register(request.worker_id, request.host)
         return pb.Empty()

@@ -82,6 +82,7 @@ class TaskDispatcher:
         self._doing = {}  # task_id -> (worker_id, _Task, start_time)
         self._job_failed = False
         self._stop_training = False
+        self._train_end_pending = False
         # Rolling completion-time stats per task type, for the timeout
         # watchdog (reference master/servicer.py:131-148).
         self._task_durations = {}  # task_type -> deque of seconds (bounded)
@@ -133,15 +134,12 @@ class TaskDispatcher:
         )
         return n
 
-    def create_train_end_callback_task(self):
-        """One final task (e.g. model export) dispatched after training ends
-        (reference task_dispatcher.py: train-end callback support)."""
+    def enable_train_end_task(self):
+        """Arm a final TRAIN_END_CALLBACK task (model export) dispatched
+        exactly once, after all training work drains. The task materializes
+        lazily inside finished() so it cannot be picked up mid-epoch."""
         with self._lock:
-            if not self._training_shards:
-                return 0
-            name = next(iter(self._training_shards))
-            self._todo.append(_Task(name, 0, 0, pb.TRAIN_END_CALLBACK))
-            return 1
+            self._train_end_pending = bool(self._training_shards)
 
     # ---------- worker-facing operations ----------
 
@@ -252,7 +250,17 @@ class TaskDispatcher:
             or self._epoch >= self._num_epochs
             or self._stop_training
         )
-        return (not self._todo) and (not self._doing) and epochs_exhausted
+        done = (not self._todo) and (not self._doing) and epochs_exhausted
+        if done and self._train_end_pending and not self._job_failed:
+            # All training/eval work drained: NOW dispatch the armed
+            # train-end task (model export) and report not-finished until a
+            # worker completes it.
+            self._train_end_pending = False
+            name = next(iter(self._training_shards))
+            self._todo.append(_Task(name, 0, 0, pb.TRAIN_END_CALLBACK))
+            logger.info("Dispatching train-end callback task")
+            return False
+        return done
 
     def finished(self):
         # NB: after stop_training() this still waits for in-flight tasks and

@@ -1,0 +1,63 @@
+"""`python -m elasticdl_tpu.ps.main` — parameter-server process entrypoint
+(reference /root/reference/elasticdl/go/cmd/elasticdl_ps/main.go:27-74).
+Exits when the master stops answering (master-liveness loop)."""
+
+import sys
+
+import grpc
+
+from elasticdl_tpu.common.args import ps_parser, validate_args
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.model_utils import get_model_spec
+from elasticdl_tpu.ps.parameter_server import ParameterServer
+from elasticdl_tpu.worker.master_client import MasterClient
+
+logger = get_logger("ps.main")
+
+
+def main(argv=None):
+    args = ps_parser().parse_args(argv)
+    validate_args(args)
+    if args.model_zoo:
+        sys.path.insert(0, args.model_zoo)
+    # The optimizer spec comes from the model zoo module, like the reference
+    # extracting -opt_type/-opt_args from the live optimizer
+    # (master/master.py:443-476); here the spec IS the serialized form.
+    spec = get_model_spec(args.model_def)
+    mc = (
+        MasterClient(args.master_addr, worker_id=-1)
+        if args.master_addr
+        else None
+    )
+    ps = ParameterServer(
+        args.ps_id,
+        args.num_ps,
+        port=args.port,
+        optimizer_spec=spec.build_optimizer_spec(),
+        use_async=args.use_async,
+        grads_to_wait=args.grads_to_wait,
+        sync_version_tolerance=args.sync_version_tolerance,
+        lr_staleness_modulation=args.lr_staleness_modulation,
+        checkpoint_dir=args.checkpoint_dir or None,
+        checkpoint_steps=args.checkpoint_steps,
+        keep_checkpoint_max=args.keep_checkpoint_max,
+        checkpoint_dir_for_init=args.checkpoint_dir_for_init or None,
+        master_client=mc,
+    )
+
+    def master_alive():
+        if mc is None:
+            return True
+        try:
+            mc.report_version(ps.parameters.version)
+            return True
+        except grpc.RpcError:
+            return False
+
+    ps.wait(master_liveness_check=master_alive, poll_seconds=10)
+    ps.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,113 @@
+"""`python -m elasticdl_tpu.worker.main` — worker process entrypoint
+(reference /root/reference/elasticdl/python/worker/main.py:28-82)."""
+
+import sys
+
+from elasticdl_tpu.common.args import validate_args, worker_parser
+from elasticdl_tpu.common.constants import DistributionStrategy, JobType
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.model_utils import get_model_spec
+from elasticdl_tpu.data.reader import create_data_reader
+from elasticdl_tpu.worker.master_client import MasterClient
+from elasticdl_tpu.worker.worker import Worker
+
+logger = get_logger("worker.main")
+
+_JOB_TYPES = {
+    "training_only": JobType.TRAINING_ONLY,
+    "training_with_evaluation": JobType.TRAINING_WITH_EVALUATION,
+    "evaluation_only": JobType.EVALUATION_ONLY,
+    "prediction_only": JobType.PREDICTION_ONLY,
+}
+
+
+def build_trainer(args, spec, master_client):
+    model = spec.build_model()
+    optimizer_spec = spec.build_optimizer_spec()
+    strategy = args.distribution_strategy
+    if strategy == DistributionStrategy.PARAMETER_SERVER:
+        from elasticdl_tpu.worker.ps_client import PSClient
+        from elasticdl_tpu.worker.ps_trainer import ParameterServerTrainer
+
+        if not args.ps_addrs:
+            raise ValueError("ParameterServerStrategy requires --ps_addrs")
+        return ParameterServerTrainer(
+            model,
+            spec.loss,
+            optimizer_spec,
+            PSClient(args.ps_addrs.split(",")),
+            embedding_inputs=getattr(spec.module, "embedding_inputs", None),
+            seed=args.seed,
+        )
+    if strategy == DistributionStrategy.ALLREDUCE:
+        from elasticdl_tpu.worker.allreduce_trainer import AllReduceTrainer
+
+        return AllReduceTrainer(
+            model,
+            spec.loss,
+            optimizer_spec,
+            master_client,
+            multi_host=args.multi_host,
+            seed=args.seed,
+        )
+    from elasticdl_tpu.worker.trainer import LocalTrainer
+
+    return LocalTrainer(model, spec.loss, optimizer_spec, seed=args.seed)
+
+
+def main(argv=None):
+    args = worker_parser().parse_args(argv)
+    validate_args(args)
+    if args.model_zoo:
+        sys.path.insert(0, args.model_zoo)
+    spec = get_model_spec(args.model_def)
+    job_type = _JOB_TYPES[args.job_type]
+    reader_factory = spec.create_data_reader or create_data_reader
+    if job_type == JobType.PREDICTION_ONLY:
+        origins = [args.prediction_data]
+    else:
+        origins = [
+            o for o in (args.training_data, args.validation_data) if o
+        ]
+    if len(origins) == 1:
+        reader = reader_factory(origins[0])
+    else:
+        # Training + validation are distinct origins: route each task to
+        # the reader owning its shard (see CompositeReader).
+        from elasticdl_tpu.data.reader import CompositeReader
+
+        reader = CompositeReader([reader_factory(o) for o in origins])
+    mc = MasterClient(
+        args.master_addr, args.worker_id, worker_host=args.worker_host
+    )
+    trainer = build_trainer(args, spec, mc)
+    extra_callbacks = []
+    if args.output:
+        from elasticdl_tpu.common.save_utils import ExportModelCallback
+
+        extra_callbacks.append(ExportModelCallback(args.output))
+    if args.checkpoint_dir_for_init and args.distribution_strategy != (
+        DistributionStrategy.PARAMETER_SERVER
+    ):
+        # Worker-side restore for local/AllReduce: the PS strategy restores
+        # server-side instead (ps/checkpoint.py). Applied right after the
+        # trainer's lazy init on the first batch.
+        trainer.restore_on_init = args.checkpoint_dir_for_init
+    worker = Worker(
+        args.worker_id,
+        mc,
+        reader,
+        spec,
+        trainer,
+        minibatch_size=args.minibatch_size,
+        job_type=job_type,
+        log_loss_steps=args.log_loss_steps,
+        extra_callbacks=extra_callbacks,
+    )
+    worker.run()
+    logger.info("Worker %d exiting", args.worker_id)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.pytree_utils import flatten_params, unflatten_like
 from elasticdl_tpu.layers.embedding import EMBEDDING_COLLECTION
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 from elasticdl_tpu.worker.trainer import JaxTrainer, _to_device_batch
@@ -29,21 +30,6 @@ from elasticdl_tpu.worker.trainer import JaxTrainer, _to_device_batch
 logger = get_logger("worker.ps_trainer")
 
 DEFAULT_MAX_PUSH_RETRIES = 3
-
-
-def flatten_params(params):
-    """params pytree -> ({wire_name: leaf}, [names in leaf order]). Names
-    are '/'-joined dict paths ('Dense_0/kernel'), stable across workers."""
-    flat, _ = jax.tree_util.tree_flatten_with_path(params)
-    named = {}
-    names = []
-    for path, leaf in flat:
-        name = "/".join(
-            str(getattr(k, "key", getattr(k, "idx", k))) for k in path
-        )
-        named[name] = leaf
-        names.append(name)
-    return named, names
 
 
 def _walk_dict(tree, path=()):
@@ -65,19 +51,6 @@ def _nest_at(paths_to_values):
             node = node.setdefault(k, {})
         node[path[-1]] = value
     return nested
-
-
-def unflatten_like(params, named):
-    """Rebuild a params-shaped pytree taking leaves from `named` by wire
-    name (missing names keep the existing leaf)."""
-    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
-    leaves = []
-    for path, leaf in flat:
-        name = "/".join(
-            str(getattr(k, "key", getattr(k, "idx", k))) for k in path
-        )
-        leaves.append(named.get(name, leaf))
-    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 class ParameterServerTrainer(JaxTrainer):

@@ -70,6 +70,9 @@ class JaxTrainer(Trainer):
         self._version = 0
         self._train_step = None
         self._forward = None
+        # Checkpoint path to restore from right after lazy init (worker-side
+        # resume for strategies whose state lives in the worker).
+        self.restore_on_init = None
 
     # ---------- init ----------
 
@@ -92,6 +95,13 @@ class JaxTrainer(Trainer):
         logger.info("Initialized model with %d parameters", n_params)
         self._train_step = self._build_train_step()
         self._forward = self._build_forward()
+        if self.restore_on_init:
+            from elasticdl_tpu.common.save_utils import (
+                restore_trainer_checkpoint,
+            )
+
+            path, self.restore_on_init = self.restore_on_init, None
+            restore_trainer_checkpoint(self, path)
 
     # ---------- step functions ----------
 

@@ -32,6 +32,7 @@ class Worker:
         job_type=JobType.TRAINING_ONLY,
         log_loss_steps=100,
         max_minibatch_retries=DEFAULT_MAX_MINIBATCH_RETRY_NUM,
+        extra_callbacks=(),
     ):
         self._worker_id = worker_id
         self._mc = master_client
@@ -46,7 +47,7 @@ class Worker:
         self._steps = 0
         self._callbacks = (
             model_spec.callbacks() if model_spec.callbacks else []
-        )
+        ) + list(extra_callbacks)
 
     # ---------- public ----------
 

@@ -1,0 +1,103 @@
+"""End-to-end CLI job: `edl train` with the local-process instance backend —
+the in-repo analog of the reference's minikube client_test.sh jobs
+(/root/reference/scripts/client_test.sh:24-141), swapping pods for local
+subprocesses. Exercises: master orchestration, worker subprocess spawn,
+record-file reading, train-end export task, evaluate-from-checkpoint."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import test_module
+from elasticdl_tpu.data.recordfile import RecordFileWriter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def linear_data(tmp_path_factory):
+    d = tmp_path_factory.mktemp("data")
+    path = str(d / "linear.edlr")
+    with RecordFileWriter(path) as w:
+        for r in test_module.make_linear_records(128):
+            w.write(r)
+    return path
+
+
+def run_edl(*argv, timeout=240):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{REPO}:{REPO}/tests"
+    # Subprocess workers must stay on the virtual CPU platform (the outer
+    # environment may point JAX at the real TPU).
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "elasticdl_tpu.client.main", *argv],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+        cwd=REPO,
+    )
+
+
+def test_train_then_evaluate_local_cluster(tmp_path, linear_data):
+    output = str(tmp_path / "model.npz")
+    res = run_edl(
+        "train",
+        "--model_zoo", f"{REPO}/tests",
+        "--model_def", "test_module",
+        "--training_data", linear_data,
+        "--num_epochs", "12",
+        "--records_per_task", "32",
+        "--minibatch_size", "32",
+        "--num_workers", "1",
+        "--distribution_strategy", "Local",
+        "--instance_backend", "local_process",
+        "--master_port", "0",
+        "--output", output,
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert os.path.exists(output)
+    with np.load(output) as data:
+        assert "params/Dense_0/kernel" in data.files
+        kernel = data["params/Dense_0/kernel"].reshape(-1)
+    np.testing.assert_allclose(kernel, test_module.TRUE_W, atol=0.1)
+
+    res = run_edl(
+        "evaluate",
+        "--model_zoo", f"{REPO}/tests",
+        "--model_def", "test_module",
+        "--validation_data", linear_data,
+        "--checkpoint_dir_for_init", output,
+        "--num_workers", "1",
+        "--distribution_strategy", "Local",
+        "--instance_backend", "local_process",
+        "--master_port", "0",
+        "--records_per_task", "64",
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert "Restored model checkpoint" in res.stderr
+
+
+def test_yaml_dump_mode(tmp_path, linear_data):
+    yaml_path = str(tmp_path / "master.json")
+    res = run_edl(
+        "train",
+        "--model_def", "test_module",
+        "--training_data", linear_data,
+        "--num_workers", "2",
+        "--instance_backend", "k8s",
+        "--image_name", "example/image:latest",
+        "--yaml", yaml_path,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    import json
+
+    with open(yaml_path) as f:
+        manifest = json.load(f)
+    command = manifest["spec"]["containers"][0]["command"]
+    assert "--yaml" not in command and yaml_path not in command
+    assert manifest["spec"]["serviceAccountName"] == "elasticdl-master"

@@ -116,11 +116,19 @@ def test_stop_training_drops_training_tasks():
 
 
 def test_train_end_callback_task():
+    """The armed train-end task materializes only after all training work
+    drains, and the job is not finished until it completes."""
     task_d = make_dispatcher(
         training_shards={"f": (0, 10)}, records_per_task=10
     )
+    task_d.enable_train_end_task()
     tid, task = task_d.get(0)
+    assert task.type == pb.TRAINING
     task_d.report(tid, True)
-    task_d.create_train_end_callback_task()
+    # Training drained: finished() dispatches the export task lazily.
+    assert not task_d.finished()
     tid, task = task_d.get(0)
     assert task.type == pb.TRAIN_END_CALLBACK
+    assert not task_d.finished()
+    task_d.report(tid, True)
+    assert task_d.finished()
