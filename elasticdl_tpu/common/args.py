@@ -122,13 +122,31 @@ def validate_args(args):
         args, "grads_to_wait", 1
     ) > 1:
         raise ValueError("async SGD requires grads_to_wait == 1")
+    # Master-side only checks (worker/PS parsers have no num_ps /
+    # instance_backend; workers enforce --ps_addrs instead).
+    num_ps = getattr(args, "num_ps", None)
     if (
         getattr(args, "distribution_strategy", None)
         == DistributionStrategy.PARAMETER_SERVER
-        and getattr(args, "num_ps", 0) < 1
-        and getattr(args, "instance_backend", "") != "none"
+        and num_ps is not None
+        and hasattr(args, "instance_backend")
+        and num_ps < 1
+        and args.instance_backend != "none"
     ):
         raise ValueError("ParameterServerStrategy requires --num_ps >= 1")
+    # A master that manages instances but has no workers to spawn would
+    # poll forever: require explicit --instance_backend none for externally
+    # launched workers.
+    if (
+        getattr(args, "instance_backend", None)
+        in ("local_process", "k8s")
+        and getattr(args, "num_workers", None) is not None
+        and args.num_workers < 1
+    ):
+        raise ValueError(
+            "--num_workers >= 1 is required (or --instance_backend none "
+            "when workers are launched externally)"
+        )
 
 
 def build_arguments_from_parsed_result(args, filter_args=None):

@@ -127,6 +127,10 @@ class LocalProcessInstanceManager:
             self._stop.wait(self._poll_seconds)
 
     def _on_exit(self, inst, code):
+        if self._stop.is_set():
+            # Teardown in progress: exits are stop()'s own SIGTERMs, not
+            # failures — relaunching here would leak processes.
+            return
         if code == 0:
             inst.status = PodStatus.SUCCEEDED
             logger.info("%s %d finished", inst.kind, inst.id)

@@ -50,6 +50,7 @@ class K8sInstanceManager:
         self._envs = envs or {}
         self._ps_service_port = ps_service_port
         self._lock = threading.Lock()
+        self._stopping = False
         self._statuses = {}  # (kind, id) -> PodStatus
         self._relaunches = {}  # (kind, id) -> count
         self._client = k8s_client.Client(
@@ -103,6 +104,7 @@ class K8sInstanceManager:
 
     def stop(self):
         with self._lock:
+            self._stopping = True
             keys = list(self._statuses)
         for kind, instance_id in keys:
             try:
@@ -113,6 +115,11 @@ class K8sInstanceManager:
     # ---------- watch-event state machine ----------
 
     def _event_cb(self, event):
+        with self._lock:
+            if self._stopping:
+                # Teardown deletes are ours; treating them as preemptions
+                # would resurrect the pods we just removed.
+                return
         pod = event["object"]
         labels = pod.metadata.labels or {}
         kind = labels.get(k8s_client.ELASTICDL_REPLICA_TYPE_KEY)

@@ -30,7 +30,9 @@ from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
 
 logger = get_logger("master.master")
 
-_COMMON_RELAY_ARGS = [
+# Flag subsets relayed into spawned processes — each must stay within what
+# the receiving parser (worker_parser / ps_parser) actually accepts.
+_WORKER_RELAY_ARGS = [
     "job_name",
     "model_zoo",
     "model_def",
@@ -43,6 +45,12 @@ _COMMON_RELAY_ARGS = [
     "prediction_data",
     "records_per_task",
     "num_epochs",
+]
+_PS_RELAY_ARGS = [
+    "job_name",
+    "model_zoo",
+    "model_def",
+    "seed",
 ]
 
 
@@ -147,14 +155,17 @@ class Master:
     PS_SERVICE_PORT = 50002
 
     def _ps_addr(self, ps_id):
-        # Local backend: PS picks port master_port+1+ps_id on this host;
-        # k8s backend: stable per-PS service names (created by the k8s
-        # instance manager) on PS_SERVICE_PORT.
+        # Local backend: PS picks port ps_base+ps_id on this host; k8s
+        # backend: stable per-PS service names (created by the k8s instance
+        # manager) on PS_SERVICE_PORT. master_port 0 means "bind any" for
+        # the master itself and cannot seed PS ports — fall back to the
+        # default base so PS ports stay valid.
         if self.args.instance_backend == "k8s":
             return (
                 f"{self.args.job_name}-ps-{ps_id}:{self.PS_SERVICE_PORT}"
             )
-        return f"127.0.0.1:{self.args.master_port + 1 + ps_id}"
+        base = self.args.master_port or 50001
+        return f"127.0.0.1:{base + 1 + ps_id}"
 
     def ps_addrs(self):
         return ",".join(
@@ -165,7 +176,10 @@ class Master:
         """argv for a spawned instance (reference master.py:424-476 builds
         worker/PS pod command lines the same way)."""
         relay = build_arguments_from_parsed_result(
-            self.args, filter_args=_COMMON_RELAY_ARGS
+            self.args,
+            filter_args=(
+                _WORKER_RELAY_ARGS if kind == "worker" else _PS_RELAY_ARGS
+            ),
         )
         if kind == "worker":
             argv = [
@@ -251,12 +265,19 @@ class Master:
                 if self.task_d.job_failed:
                     logger.error("Job failed (task retries exhausted)")
                     return 1
-                if (
-                    self.instance_manager is not None
-                    and self.instance_manager.all_workers_failed()
-                ):
-                    logger.error("All workers failed; aborting job")
-                    return 1
+                if self.instance_manager is not None:
+                    if self.instance_manager.all_workers_failed():
+                        logger.error("All workers failed; aborting job")
+                        return 1
+                    if self.instance_manager.all_workers_done():
+                        # Every worker reached a terminal state yet tasks
+                        # remain (finished() was checked above): nothing can
+                        # make progress.
+                        logger.error(
+                            "All workers exited but tasks remain; "
+                            "aborting job"
+                        )
+                        return 1
                 now = time.time()
                 if (
                     now - last_watchdog
@@ -276,7 +297,7 @@ class Master:
         )
         silent = {
             wid
-            for wid, ts in self.servicer.worker_liveness.items()
+            for wid, ts in self.servicer.snapshot_liveness().items()
             if ts < deadline
         }
         for worker_id in slow | silent:
@@ -287,7 +308,11 @@ class Master:
                 worker_id,
             )
             self.task_d.recover_tasks(worker_id)
-            self.servicer.worker_liveness.pop(worker_id, None)
+            self.servicer.forget_worker(worker_id)
+            if self.membership is not None:
+                # Drop it from the comm group so survivors re-mesh instead
+                # of blocking on the dead rank's next collective.
+                self.membership.remove_worker(worker_id)
 
     def stop(self):
         if self.instance_manager is not None:

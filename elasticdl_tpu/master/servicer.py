@@ -37,6 +37,16 @@ class MasterServicer:
         with self._lock:
             self.worker_liveness[worker_id] = time.time()
 
+    def snapshot_liveness(self):
+        """Copy of the liveness table (the watchdog iterates it while gRPC
+        threads insert)."""
+        with self._lock:
+            return dict(self.worker_liveness)
+
+    def forget_worker(self, worker_id):
+        with self._lock:
+            self.worker_liveness.pop(worker_id, None)
+
     # ---------- rpc methods (names match rpc.MASTER_SERVICE) ----------
 
     def get_task(self, request, context):

@@ -1,0 +1,156 @@
+"""Decoder-only transformer LM — the long-context flagship model.
+
+No reference counterpart (the reference zoo is CNNs/recsys; long-context is
+this framework's extension). TPU-first choices: bfloat16 activations with
+float32 params/softmax, flash attention (ops/flash_attention.py) on the
+local path, and a pluggable attention callable so the DP+SP training step
+can drop in ring attention or Ulysses (parallel/ring_attention.py,
+parallel/ulysses.py) over a ("data", "seq") mesh — see
+__graft_entry__.dryrun_multichip for the sharded wiring.
+
+Model spec contract (common/model_utils.py): custom_model / loss /
+optimizer / feed / eval_metrics_fn.
+"""
+
+import dataclasses
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.evaluation_utils import MeanMetric
+from elasticdl_tpu.common.model_utils import Modes
+from elasticdl_tpu.data.example import batch_examples
+from elasticdl_tpu.ops import optimizers
+from elasticdl_tpu.ops.flash_attention import flash_attention
+
+VOCAB = 256
+D_MODEL = 128
+N_HEADS = 4
+N_LAYERS = 2
+MAX_LEN = 256
+
+
+@dataclasses.dataclass(frozen=True)
+class LMConfig:
+    vocab: int = VOCAB
+    d_model: int = D_MODEL
+    n_heads: int = N_HEADS
+    n_layers: int = N_LAYERS
+    max_len: int = MAX_LEN
+    dropout: float = 0.0
+    # attention(q, k, v) with causal masking baked in; None -> local flash.
+    attention: Optional[Callable] = None
+    # bfloat16 activations keep the MXU in its native dtype.
+    activation_dtype: str = "bfloat16"
+
+
+def _default_attention(q, k, v):
+    return flash_attention(q, k, v, True)
+
+
+class MultiHeadAttention(nn.Module):
+    config: LMConfig
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        cfg = self.config
+        head_dim = cfg.d_model // cfg.n_heads
+        dtype = jnp.dtype(cfg.activation_dtype)
+        qkv = nn.DenseGeneral(
+            (3, cfg.n_heads, head_dim), dtype=dtype, name="qkv"
+        )(x)
+        # [B, S, 3, H, Dh] -> three [B, H, S, Dh]
+        q, k, v = jnp.moveaxis(qkv, 2, 0)
+        q = jnp.swapaxes(q, 1, 2)
+        k = jnp.swapaxes(k, 1, 2)
+        v = jnp.swapaxes(v, 1, 2)
+        attend = cfg.attention or _default_attention
+        # Softmax path in float32 for stability; back to compute dtype.
+        out = attend(
+            q.astype(jnp.float32),
+            k.astype(jnp.float32),
+            v.astype(jnp.float32),
+        ).astype(dtype)
+        out = jnp.swapaxes(out, 1, 2).reshape(*x.shape[:2], cfg.d_model)
+        return nn.Dense(cfg.d_model, dtype=dtype, name="proj")(out)
+
+
+class Block(nn.Module):
+    config: LMConfig
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.activation_dtype)
+        h = nn.LayerNorm(dtype=dtype)(x)
+        x = x + MultiHeadAttention(cfg)(h, training)
+        h = nn.LayerNorm(dtype=dtype)(x)
+        h = nn.Dense(4 * cfg.d_model, dtype=dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.d_model, dtype=dtype)(h)
+        if cfg.dropout:
+            h = nn.Dropout(cfg.dropout, deterministic=not training)(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    config: LMConfig = LMConfig()
+
+    @nn.compact
+    def __call__(self, tokens, training: bool = False):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.activation_dtype)
+        s = tokens.shape[1]
+        x = nn.Embed(cfg.vocab, cfg.d_model, dtype=dtype, name="tok_emb")(
+            tokens.astype(jnp.int32)
+        )
+        pos = nn.Embed(cfg.max_len, cfg.d_model, dtype=dtype,
+                       name="pos_emb")(jnp.arange(s))
+        x = x + pos[None]
+        for _ in range(cfg.n_layers):
+            x = Block(cfg)(x, training)
+        x = nn.LayerNorm(dtype=dtype)(x)
+        # Logits in float32: softmax/CE stay out of bfloat16.
+        return nn.Dense(cfg.vocab, dtype=jnp.float32, name="lm_head")(x)
+
+
+# ---------- model spec contract ----------
+
+
+def custom_model(config: LMConfig = None):
+    return TransformerLM(config or LMConfig())
+
+
+def loss(labels, logits):
+    """Next-token CE; labels [B, S] int, logits [B, S, V]."""
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels.astype(jnp.int32)
+        )
+    )
+
+
+def optimizer():
+    return optimizers.adam(learning_rate=3e-4)
+
+
+def feed(records, mode, metadata):
+    batch = batch_examples(records)
+    tokens = batch["tokens"].astype(np.int32)  # [B, S+1]
+    features = tokens[:, :-1]
+    labels = tokens[:, 1:] if mode != Modes.PREDICTION else None
+    return features, labels
+
+
+def eval_metrics_fn():
+    def ce(outputs, labels):
+        logits = np.asarray(outputs, np.float32)
+        labels = np.asarray(labels).astype(np.int64)
+        logits = logits - logits.max(-1, keepdims=True)
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        return -np.take_along_axis(logp, labels[..., None], -1)
+
+    return {"token_ce": MeanMetric(ce)}

@@ -1,0 +1,200 @@
+"""Flash attention for TPU via Pallas, with an XLA reference fallback.
+
+No reference-framework counterpart (the reference is DP-only and has no
+attention ops; SURVEY.md §5 marks long-context as absent upstream) — this is
+a capability extension required for long-context training. Design follows
+the standard blockwise online-softmax scheme: grid over (batch*heads,
+q_blocks); the kernel streams K/V blocks from VMEM, keeping running
+(max, sum, acc) so the S x S score matrix never materializes
+(/opt/skills/guides/pallas_guide.md: MXU tiling + VMEM residency).
+
+The backward pass uses the saved log-sum-exp to recompute P blockwise in
+plain XLA — correct and O(S^2) compute but not O(S^2) memory per block pair;
+a fused Pallas backward is future work. Under ring/Ulysses sequence
+parallelism (parallel/ring_attention.py) the per-device S is the block, so
+this bound is the per-shard sequence, not the global one.
+"""
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _use_pallas():
+    if os.environ.get("EDL_FORCE_PALLAS_INTERPRET"):
+        return True
+    return jax.default_backend() == "tpu"
+
+
+# ---------- reference path (also the correctness oracle in tests) ----------
+
+
+def reference_attention(q, k, v, causal=False):
+    """[B, H, S, D] full attention in plain XLA."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        scores = jnp.where(mask, scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+
+
+# ---------- pallas kernel ----------
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale):
+    # q_ref: [block_q, D]; k_ref/v_ref: [S, D] for this (batch, head).
+    from jax.experimental import pallas as pl
+
+    block_q, d = q_ref.shape
+    s = k_ref.shape[0]
+    q_block_idx = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32) * scale
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    num_k_blocks = s // block_k
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        scores = jnp.dot(
+            q, k_blk.T, preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        if causal:
+            q_pos = q_block_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[:, None])
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        acc_new = acc * alpha[:, None] + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    if causal:
+        # Blocks fully above the diagonal contribute nothing; stop at the
+        # last k-block this q-block can see: ceil((i+1)*block_q / block_k).
+        last = jnp.minimum(
+            num_k_blocks,
+            ((q_block_idx + 1) * block_q + block_k - 1) // block_k,
+        )
+        m, l, acc = jax.lax.fori_loop(0, last, body, (m0, l0, acc0))
+    else:
+        m, l, acc = jax.lax.fori_loop(
+            0, num_k_blocks, body, (m0, l0, acc0)
+        )
+    # lse is NOT emitted: a 1-D per-row output violates the TPU (8, 128)
+    # block-tiling constraint, and the backward recomputes scores anyway —
+    # it rederives lse there for free (see _bwd).
+    o_ref[:] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, block_q, block_k):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, s, d = q.shape
+    bh = b * h
+    scale = d**-0.5
+    q3 = q.reshape(bh, s, d)
+    k3 = k.reshape(bh, s, d)
+    v3 = v.reshape(bh, s, d)
+    grid = (bh, s // block_q)
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, causal=causal, scale=scale
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            # Leading None squeezes the (batch*head) dim off the refs.
+            pl.BlockSpec(
+                (None, block_q, d),
+                lambda i, j: (i, j, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (None, s, d), lambda i, j: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (None, s, d), lambda i, j: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (None, block_q, d),
+            lambda i, j: (i, j, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        interpret=bool(os.environ.get("EDL_FORCE_PALLAS_INTERPRET")),
+    )(q3, k3, v3)
+    return out.reshape(b, h, s, d)
+
+
+# ---------- public API with custom VJP ----------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(
+    q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K
+):
+    """Attention over [B, H, S, D]; S must be a multiple of the block sizes
+    on the Pallas path (the reference path has no constraint)."""
+    return _forward_impl(q, k, v, causal, block_q, block_k)
+
+
+def _forward_impl(q, k, v, causal, block_q, block_k):
+    s = q.shape[2]
+    if _use_pallas() and s % block_q == 0 and s % block_k == 0:
+        return _flash_forward(q, k, v, causal, block_q, block_k)
+    return reference_attention(q, k, v, causal)
+
+
+def _fwd(q, k, v, causal, block_q, block_k):
+    out = _forward_impl(q, k, v, causal, block_q, block_k)
+    return out, (q, k, v, out)
+
+
+def _bwd(causal, block_q, block_k, residuals, g):
+    """Standard flash backward: scores recomputed (so lse comes for free),
+    then dV = P^T g;  dP = g V^T;  dS = P * (dP - rowsum(g * out));
+    dQ = dS K * scale;  dK = dS^T Q * scale."""
+    q, k, v, out = residuals
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        scores = jnp.where(mask, scores, NEG_INF)
+    lse = jax.nn.logsumexp(scores, axis=-1)
+    p = jnp.exp(scores - lse[..., None])
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, g)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", g, v)
+    delta = jnp.sum(g * out, axis=-1, keepdims=True)
+    ds = p * (dp - delta)
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k) * scale
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -1,0 +1,124 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+Capability extension beyond the reference (which is DP-only; SURVEY.md §5
+marks long-context absent upstream). Each device holds one sequence block of
+Q/K/V; K/V blocks rotate around the mesh's sequence axis with
+`lax.ppermute` while every device folds each arriving block into a running
+online-softmax accumulator (max, sum, acc) — the blockwise-parallel /
+RingAttention scheme. Communication rides ICI; compute between hops is a
+dense [S_local x S_local] attention block on the MXU, so the transfer of the
+next block overlaps the math of the current one under XLA's async
+collectives.
+
+Causality across blocks uses the GLOBAL block order: device i skips blocks
+j > i entirely (they're fully masked) and applies the triangular mask only
+on its own diagonal block.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, scale, mask=None):
+    """One blockwise contribution: returns (m, l, acc) for q against this
+    k/v block. q: [B,H,Sq,D]; k,v: [B,H,Sk,D]."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)
+    p = jnp.exp(scores - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return m, l, acc
+
+
+def _merge(m1, l1, acc1, m2, l2, acc2):
+    """Merge two online-softmax partials (the associative combine)."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    return (
+        m,
+        l1 * a1 + l2 * a2,
+        acc1 * a1[..., None] + acc2 * a2[..., None],
+    )
+
+
+def ring_attention(q, k, v, axis_name, causal=False):
+    """Exact attention with Q/K/V sharded [B, H, S_local, D] along
+    `axis_name`. Call INSIDE shard_map; returns the local output block.
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    scale = q.shape[-1] ** -0.5
+    s_local = q.shape[2]
+
+    m0 = jnp.full(q.shape[:-1], NEG_INF, jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1], jnp.float32)
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+
+    # Ring: at step t this device holds the K/V block originally owned by
+    # device (my_idx - t) mod N.
+    def step(t, carry):
+        m, l, acc, k_blk, v_blk = carry
+        owner = (my_idx - t) % axis_size
+        if causal:
+            # Full block mask decisions by global block order.
+            def masked_block():
+                q_pos = my_idx * s_local + jax.lax.broadcasted_iota(
+                    jnp.int32, (s_local, k_blk.shape[2]), 0
+                )
+                k_pos = owner * s_local + jax.lax.broadcasted_iota(
+                    jnp.int32, (s_local, k_blk.shape[2]), 1
+                )
+                return _block_attend(
+                    q, k_blk, v_blk, scale, mask=(q_pos >= k_pos)
+                )
+
+            def skip_block():
+                return (
+                    jnp.full(q.shape[:-1], NEG_INF, jnp.float32),
+                    jnp.zeros(q.shape[:-1], jnp.float32),
+                    jnp.zeros(q.shape, jnp.float32),
+                )
+
+            mb, lb, accb = jax.lax.cond(
+                owner <= my_idx, masked_block, skip_block
+            )
+        else:
+            mb, lb, accb = _block_attend(q, k_blk, v_blk, scale)
+        m, l, acc = _merge(m, l, acc, mb, lb, accb)
+        # Rotate K/V to the next device (skip after the last fold).
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return m, l, acc, k_next, v_next
+
+    m, l, acc, _, _ = jax.lax.fori_loop(
+        0, axis_size, step, (m0, l0, acc0, k, v)
+    )
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def make_ring_attention(mesh, axis_name="seq", causal=False,
+                        batch_axis=None):
+    """shard_map-wrapped ring attention: takes GLOBAL [B, H, S, D] arrays
+    sharded on S (and optionally on B along `batch_axis` for DP+SP meshes)
+    and returns the global output with the same sharding."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    spec = P(batch_axis, None, axis_name, None)
+    return shard_map(
+        functools.partial(
+            ring_attention, axis_name=axis_name, causal=causal
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )

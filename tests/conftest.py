@@ -4,7 +4,9 @@ so sharding/collective tests run without TPU hardware."""
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Override unconditionally: the machine may pin JAX_PLATFORMS to the real
+# TPU platform, and sharding tests need the 8-device virtual CPU world.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -12,3 +14,11 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# A TPU-attach hook (sitecustomize) may have already imported jax and forced
+# its platform config past the env vars; override it back at the config
+# level before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
