@@ -164,7 +164,10 @@ class Master:
             return (
                 f"{self.args.job_name}-ps-{ps_id}:{self.PS_SERVICE_PORT}"
             )
-        base = self.args.master_port or 50001
+        # With --master_port 0, derive from the ACTUALLY BOUND master port
+        # (prepare() runs before any instance spawns) so two concurrent
+        # jobs on one host don't collide on a fixed base.
+        base = self.args.master_port or self.port or 50001
         return f"127.0.0.1:{base + 1 + ps_id}"
 
     def ps_addrs(self):

@@ -1,0 +1,59 @@
+"""Census DNN zoo model (reference /root/reference/model_zoo/
+census_dnn_model/ — embeddings for categorical features + MLP)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.evaluation_utils import MeanMetric
+from elasticdl_tpu.common.model_utils import Modes
+from elasticdl_tpu.models.census.wide_deep import (
+    TOTAL_IDS,
+    feed,  # noqa: F401  (same feature pipeline)
+    make_records,  # noqa: F401
+)
+from elasticdl_tpu.ops import optimizers
+
+EMB_DIM = 16
+
+
+class CensusDNN(nn.Module):
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        ids = features["ids"]
+        table = self.param(
+            "emb",
+            nn.initializers.uniform(scale=0.05),
+            (TOTAL_IDS, EMB_DIM),
+        )
+        x = jnp.take(table, ids.astype(jnp.int32), axis=0).reshape(
+            ids.shape[0], -1
+        )
+        for width in (64, 32):
+            x = nn.relu(nn.Dense(width)(x))
+        return nn.Dense(1)(x).reshape(-1)
+
+
+def custom_model():
+    return CensusDNN()
+
+
+def loss(labels, logits):
+    return jnp.mean(
+        optax.sigmoid_binary_cross_entropy(
+            logits.reshape(-1), labels.reshape(-1).astype(jnp.float32)
+        )
+    )
+
+
+def optimizer(lr=0.001):
+    return optimizers.adam(learning_rate=lr)
+
+
+def eval_metrics_fn():
+    def correct(outputs, labels):
+        preds = (np.asarray(outputs).reshape(-1) > 0).astype(np.float32)
+        return (preds == np.asarray(labels).reshape(-1)).astype(np.float32)
+
+    return {"accuracy": MeanMetric(correct)}

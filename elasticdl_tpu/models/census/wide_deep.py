@@ -1,0 +1,149 @@
+"""Census wide & deep zoo model.
+
+Reference counterpart: /root/reference/model_zoo/census_wide_deep_model/
+wide_deep_functional_api.py — categorical features hashed/bucketized into
+id groups, a wide linear part (dim-1 embeddings summed) plus a deep part
+(dim-8 embeddings -> MLP), summed into a sigmoid logit. The feature
+transforms come from the preprocessing package (hashing/discretization),
+applied host-side in `feed` so the device sees pure id/float arrays.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.evaluation_utils import MeanMetric
+from elasticdl_tpu.common.model_utils import Modes
+from elasticdl_tpu.data.example import batch_examples
+from elasticdl_tpu.ops import optimizers
+from elasticdl_tpu.preprocessing.layers import Discretization, Hashing
+
+# Feature config: 4 categorical (hashed) + 2 numeric (bucketized) features.
+CATEGORICAL_BINS = {"workclass": 30, "education": 30, "occupation": 50,
+                    "relationship": 20}
+AGE_BOUNDARIES = [25, 35, 45, 55, 65]
+HOURS_BOUNDARIES = [20, 35, 45]
+
+_hashers = {name: Hashing(bins) for name, bins in CATEGORICAL_BINS.items()}
+_age_bucket = Discretization(AGE_BOUNDARIES)
+_hours_bucket = Discretization(HOURS_BOUNDARIES)
+
+# Offsets concatenate all id spaces into one vocabulary for the shared
+# wide/deep embedding tables (reference: ConcatenateWithOffset +
+# Embedding(input_dim=total)).
+_GROUPS = list(CATEGORICAL_BINS) + ["age_bucket", "hours_bucket"]
+_SIZES = list(CATEGORICAL_BINS.values()) + [
+    len(AGE_BOUNDARIES) + 1,
+    len(HOURS_BOUNDARIES) + 1,
+]
+OFFSETS = np.concatenate([[0], np.cumsum(_SIZES)[:-1]])
+TOTAL_IDS = int(np.sum(_SIZES))
+DEEP_DIM = 8
+
+
+class WideDeep(nn.Module):
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        ids = features["ids"]  # [B, n_groups] offset ids
+        wide_table = self.param(
+            "wide", nn.initializers.zeros, (TOTAL_IDS, 1)
+        )
+        deep_table = self.param(
+            "deep",
+            nn.initializers.uniform(scale=0.05),
+            (TOTAL_IDS, DEEP_DIM),
+        )
+        wide = jnp.sum(
+            jnp.take(wide_table, ids.astype(jnp.int32), axis=0), axis=1
+        )  # [B, 1]
+        deep = jnp.take(
+            deep_table, ids.astype(jnp.int32), axis=0
+        ).reshape(ids.shape[0], -1)
+        for width in (16, 16, 16):
+            deep = nn.relu(nn.Dense(width)(deep))
+        deep = nn.Dense(1)(deep)
+        return (wide + deep).reshape(-1)
+
+
+def custom_model():
+    return WideDeep()
+
+
+def loss(labels, logits):
+    return jnp.mean(
+        optax.sigmoid_binary_cross_entropy(
+            logits.reshape(-1), labels.reshape(-1).astype(jnp.float32)
+        )
+    )
+
+
+def optimizer(lr=0.01):
+    return optimizers.adam(learning_rate=lr)
+
+
+def feed(records, mode, metadata):
+    batch = batch_examples(records)
+    cols = []
+    for i, name in enumerate(CATEGORICAL_BINS):
+        cols.append(_hashers[name](batch[name]) + OFFSETS[i])
+    cols.append(
+        _age_bucket(batch["age"]) + OFFSETS[len(CATEGORICAL_BINS)]
+    )
+    cols.append(
+        _hours_bucket(batch["hours"]) + OFFSETS[len(CATEGORICAL_BINS) + 1]
+    )
+    ids = np.stack([np.asarray(c).reshape(-1) for c in cols], axis=1)
+    labels = (
+        batch["label"].astype(np.float32)
+        if mode != Modes.PREDICTION
+        else None
+    )
+    return {"ids": ids.astype(np.int64)}, labels
+
+
+def eval_metrics_fn():
+    def correct(outputs, labels):
+        preds = (np.asarray(outputs).reshape(-1) > 0).astype(np.float32)
+        return (preds == np.asarray(labels).reshape(-1)).astype(np.float32)
+
+    return {"accuracy": MeanMetric(correct)}
+
+
+def make_records(n, seed=0):
+    """Synthetic census-like rows with a learnable relationship between
+    the hashed groups and the label."""
+    from elasticdl_tpu.data.example import encode_example
+
+    rng = np.random.default_rng(seed)
+    weights = rng.normal(size=TOTAL_IDS).astype(np.float32)
+    records = []
+    for _ in range(n):
+        row = {
+            name: np.int64(rng.integers(0, 1000))
+            for name in CATEGORICAL_BINS
+        }
+        row["age"] = np.float32(rng.uniform(18, 80))
+        row["hours"] = np.float32(rng.uniform(5, 60))
+        feats, _ = feed_row(row)
+        score = weights[feats].sum()
+        row["label"] = np.int64(score > 0)
+        records.append(encode_example(row))
+    return records
+
+
+def feed_row(row):
+    cols = []
+    for i, name in enumerate(CATEGORICAL_BINS):
+        cols.append(
+            int(_hashers[name](np.asarray([row[name]]))[0]) + OFFSETS[i]
+        )
+    cols.append(
+        int(_age_bucket(np.asarray([row["age"]]))[0])
+        + OFFSETS[len(CATEGORICAL_BINS)]
+    )
+    cols.append(
+        int(_hours_bucket(np.asarray([row["hours"]]))[0])
+        + OFFSETS[len(CATEGORICAL_BINS) + 1]
+    )
+    return np.asarray(cols, np.int64), None

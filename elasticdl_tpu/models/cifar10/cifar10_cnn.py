@@ -1,0 +1,66 @@
+"""CIFAR-10 CNN zoo model.
+
+Reference counterpart: /root/reference/model_zoo/cifar10/
+cifar10_functional_api.py:16-103 — three (Conv-BN-relu)x2 + MaxPool +
+Dropout stages at 32/64/128 channels, flatten, softmax head; Adam with LR
+schedule callback. NHWC layout for MXU-friendly convs.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from elasticdl_tpu.common.evaluation_utils import accuracy_metric
+from elasticdl_tpu.common.model_utils import Modes
+from elasticdl_tpu.data.example import batch_examples
+from elasticdl_tpu.ops import optimizers
+
+NUM_CLASSES = 10
+
+
+class Cifar10CNN(nn.Module):
+    num_classes: int = NUM_CLASSES
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = x.reshape(x.shape[0], 32, 32, 3)
+        for channels in (32, 64, 128):
+            for _ in range(2):
+                x = nn.Conv(channels, (3, 3), padding="SAME")(x)
+                x = nn.BatchNorm(
+                    use_running_average=not training,
+                    epsilon=1e-6,
+                    momentum=0.9,
+                )(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            x = nn.Dropout(0.2, deterministic=not training)(x)
+        x = x.reshape(x.shape[0], -1)
+        return nn.Dense(self.num_classes)(x)
+
+
+def custom_model():
+    return Cifar10CNN()
+
+
+def loss(labels, predictions):
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(
+            predictions, labels.reshape(-1)
+        )
+    )
+
+
+def optimizer(lr=0.001):
+    return optimizers.adam(learning_rate=lr)
+
+
+def feed(records, mode, metadata):
+    batch = batch_examples(records)
+    features = batch["image"].astype("float32")
+    labels = batch["label"] if mode != Modes.PREDICTION else None
+    return features, labels
+
+
+def eval_metrics_fn():
+    return {"accuracy": accuracy_metric()}

@@ -1,0 +1,54 @@
+"""DeepFM with PS-resident embedding tables.
+
+Reference counterpart: /root/reference/model_zoo/deepfm_edl_embedding/
+deepfm_edl_embedding.py:19-58 — same architecture as the functional DeepFM
+but the first-order weights and FM factors live in the parameter server via
+the distributed embedding layer, so the (potentially huge) vocabulary never
+materializes in device memory. `embedding_inputs` feeds the PS trainer's
+prefetch (see worker/ps_trainer.py).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from elasticdl_tpu.layers.embedding import DistributedEmbedding
+from elasticdl_tpu.models.deepfm.deepfm_functional import (  # noqa: F401
+    EMB_DIM,
+    FIELDS,
+    eval_metrics_fn,
+    feed,
+    loss,
+    make_records,
+    optimizer,
+)
+
+
+class DeepFMDistributed(nn.Module):
+    emb_dim: int = EMB_DIM
+
+    @nn.compact
+    def __call__(self, ids, training: bool = False):
+        linear_emb = DistributedEmbedding(
+            table_name="fm_linear", dim=1
+        )(ids)  # [B, F, 1]
+        v = DistributedEmbedding(
+            table_name="fm_factors", dim=self.emb_dim
+        )(ids)  # [B, F, D]
+        linear = jnp.sum(linear_emb, axis=(1, 2))
+        sum_sq = jnp.square(jnp.sum(v, axis=1))
+        sq_sum = jnp.sum(jnp.square(v), axis=1)
+        fm = 0.5 * jnp.sum(sum_sq - sq_sum, axis=1)
+        deep = v.reshape(ids.shape[0], -1)
+        for width in (64, 32):
+            deep = nn.relu(nn.Dense(width)(deep))
+        deep = nn.Dense(1)(deep).reshape(-1)
+        return linear + fm + deep
+
+
+def custom_model():
+    return DeepFMDistributed()
+
+
+def embedding_inputs(features):
+    """Both PS tables key off the same field-id array."""
+    return {"fm_linear": features, "fm_factors": features}

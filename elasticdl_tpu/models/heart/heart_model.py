@@ -1,0 +1,110 @@
+"""Heart-disease binary classifier (reference /root/reference/model_zoo/
+heart_functional_api/ — mixed numeric + categorical columns through
+normalizer/bucketize/hash transforms into a small MLP)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.evaluation_utils import MeanMetric
+from elasticdl_tpu.common.model_utils import Modes
+from elasticdl_tpu.data.example import batch_examples, encode_example
+from elasticdl_tpu.ops import optimizers
+from elasticdl_tpu.preprocessing.layers import (
+    Discretization,
+    Hashing,
+    Normalizer,
+)
+
+NUMERIC = ["age", "trestbps", "chol", "thalach", "oldpeak"]
+_norms = {
+    "age": Normalizer(54.0, 9.0),
+    "trestbps": Normalizer(131.0, 17.0),
+    "chol": Normalizer(246.0, 51.0),
+    "thalach": Normalizer(149.0, 22.0),
+    "oldpeak": Normalizer(1.0, 1.1),
+}
+_thal_hash = Hashing(8)
+_age_bucket = Discretization([40, 50, 60])
+THAL_BINS = 8
+AGE_BINS = 4
+
+
+class HeartModel(nn.Module):
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        numeric = features["numeric"]  # [B, 5] normalized
+        thal = nn.Embed(THAL_BINS, 4)(features["thal_id"].astype(jnp.int32))
+        age = nn.Embed(AGE_BINS, 4)(features["age_bucket"].astype(jnp.int32))
+        x = jnp.concatenate([numeric, thal, age], axis=-1)
+        x = nn.relu(nn.Dense(32)(x))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(1)(x).reshape(-1)
+
+
+def custom_model():
+    return HeartModel()
+
+
+def loss(labels, logits):
+    return jnp.mean(
+        optax.sigmoid_binary_cross_entropy(
+            logits.reshape(-1), labels.reshape(-1).astype(jnp.float32)
+        )
+    )
+
+
+def optimizer(lr=0.01):
+    return optimizers.adam(learning_rate=lr)
+
+
+def feed(records, mode, metadata):
+    batch = batch_examples(records)
+    numeric = np.stack(
+        [_norms[name](batch[name].astype(np.float32)) for name in NUMERIC],
+        axis=1,
+    )
+    features = {
+        "numeric": numeric.astype(np.float32),
+        "thal_id": _thal_hash(batch["thal"].astype(np.int64)),
+        "age_bucket": _age_bucket(batch["age"].astype(np.float32)),
+    }
+    labels = (
+        batch["label"].astype(np.float32)
+        if mode != Modes.PREDICTION
+        else None
+    )
+    return features, labels
+
+
+def eval_metrics_fn():
+    def correct(outputs, labels):
+        preds = (np.asarray(outputs).reshape(-1) > 0).astype(np.float32)
+        return (preds == np.asarray(labels).reshape(-1)).astype(np.float32)
+
+    return {"accuracy": MeanMetric(correct)}
+
+
+def make_records(n, seed=0):
+    rng = np.random.default_rng(seed)
+    records = []
+    for _ in range(n):
+        age = rng.uniform(29, 77)
+        chol = rng.uniform(150, 400)
+        thalach = rng.uniform(90, 200)
+        label = int(0.03 * age + 0.004 * chol - 0.02 * thalach > 0)
+        records.append(
+            encode_example(
+                {
+                    "age": np.float32(age),
+                    "trestbps": np.float32(rng.uniform(100, 170)),
+                    "chol": np.float32(chol),
+                    "thalach": np.float32(thalach),
+                    "oldpeak": np.float32(rng.uniform(0, 4)),
+                    "thal": np.int64(rng.integers(0, 30)),
+                    "label": np.int64(label),
+                }
+            )
+        )
+    return records

@@ -1,0 +1,68 @@
+"""Iris DNN (reference /root/reference/model_zoo/odps_iris_dnn_model/ —
+4 numeric features -> 2x Dense -> 3-way softmax; its feed parses CSV-style
+string rows, exercising the CSV reader path)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.evaluation_utils import accuracy_metric
+from elasticdl_tpu.common.model_utils import Modes
+from elasticdl_tpu.ops import optimizers
+
+
+class IrisDNN(nn.Module):
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = nn.relu(nn.Dense(16)(x))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(3)(x)
+
+
+def custom_model():
+    return IrisDNN()
+
+
+def loss(labels, predictions):
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(
+            predictions, labels.reshape(-1).astype(jnp.int32)
+        )
+    )
+
+
+def optimizer(lr=0.1):
+    return optimizers.adagrad(learning_rate=lr)
+
+
+def feed(records, mode, metadata):
+    """Records are CSV row tuples of strings (CSVDataReader output):
+    sepal_len, sepal_w, petal_len, petal_w, label."""
+    rows = np.asarray(
+        [[float(v) for v in row] for row in records], np.float32
+    )
+    features = rows[:, :4]
+    labels = rows[:, 4] if mode != Modes.PREDICTION else None
+    return features, labels
+
+
+def eval_metrics_fn():
+    return {"accuracy": accuracy_metric()}
+
+
+def make_csv(path, n=150, seed=0):
+    """Synthetic separable iris-like CSV."""
+    rng = np.random.default_rng(seed)
+    centers = np.asarray(
+        [[5.0, 3.4, 1.5, 0.2], [5.9, 2.8, 4.3, 1.3], [6.6, 3.0, 5.6, 2.1]],
+        np.float32,
+    )
+    with open(path, "w") as f:
+        for _ in range(n):
+            label = rng.integers(0, 3)
+            row = centers[label] + rng.normal(scale=0.15, size=4)
+            f.write(
+                ",".join(f"{v:.3f}" for v in row) + f",{label}\n"
+            )
+    return path

@@ -1,0 +1,118 @@
+"""ResNet-50 zoo model (the throughput-benchmark workhorse).
+
+Reference counterparts: /root/reference/model_zoo/imagenet_resnet50/ and
+resnet50_subclass/ (bottleneck-v1 architecture; the reference benchmarks
+report img/s on it, BASELINE.md). TPU-first: NHWC, bfloat16 activations
+with float32 BatchNorm statistics and float32 logits — the standard
+TPU ResNet recipe, MXU-native convs.
+"""
+
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from elasticdl_tpu.common.evaluation_utils import accuracy_metric
+from elasticdl_tpu.common.model_utils import Modes
+from elasticdl_tpu.data.example import batch_examples
+from elasticdl_tpu.ops import optimizers
+
+NUM_CLASSES = 1000
+STAGE_SIZES = [3, 4, 6, 3]  # ResNet-50
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        dtype = jnp.dtype(self.dtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not training,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=jnp.float32,
+        )
+        conv = partial(nn.Conv, use_bias=False, dtype=dtype)
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = norm()(y).astype(dtype)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                 padding="SAME")(y)
+        y = norm()(y).astype(dtype)
+        y = nn.relu(y)
+        y = conv(4 * self.filters, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y).astype(dtype)
+        if residual.shape != y.shape:
+            residual = conv(
+                4 * self.filters,
+                (1, 1),
+                strides=(self.strides, self.strides),
+                name="proj",
+            )(residual)
+            residual = norm(name="proj_bn")(residual).astype(dtype)
+        return nn.relu(residual + y)
+
+
+class ResNet50(nn.Module):
+    num_classes: int = NUM_CLASSES
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        dtype = jnp.dtype(self.dtype)
+        x = x.astype(dtype)
+        x = nn.Conv(
+            64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+            use_bias=False, dtype=dtype,
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not training,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=jnp.float32,
+        )(x).astype(dtype)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, block_count in enumerate(STAGE_SIZES):
+            for block in range(block_count):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BottleneckBlock(
+                    filters=64 * 2**stage,
+                    strides=strides,
+                    dtype=self.dtype,
+                )(x, training)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def custom_model():
+    return ResNet50()
+
+
+def loss(labels, predictions):
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(
+            predictions, labels.reshape(-1)
+        )
+    )
+
+
+def optimizer(lr=0.1):
+    return optimizers.momentum(learning_rate=lr, momentum_value=0.9)
+
+
+def feed(records, mode, metadata):
+    batch = batch_examples(records)
+    features = batch["image"].astype("float32")
+    labels = batch["label"] if mode != Modes.PREDICTION else None
+    return features, labels
+
+
+def eval_metrics_fn():
+    return {"accuracy": accuracy_metric()}

@@ -104,6 +104,12 @@ class TransformerLM(nn.Module):
         cfg = self.config
         dtype = jnp.dtype(cfg.activation_dtype)
         s = tokens.shape[1]
+        if s > cfg.max_len:
+            # Without this, the positional gather would silently clamp
+            # out-of-range indices under XLA and corrupt positions.
+            raise ValueError(
+                f"sequence length {s} exceeds max_len {cfg.max_len}"
+            )
         x = nn.Embed(cfg.vocab, cfg.d_model, dtype=dtype, name="tok_emb")(
             tokens.astype(jnp.int32)
         )

@@ -92,10 +92,23 @@ def ring_attention(q, k, v, axis_name, causal=False):
         else:
             mb, lb, accb = _block_attend(q, k_blk, v_blk, scale)
         m, l, acc = _merge(m, l, acc, mb, lb, accb)
-        # Rotate K/V to the next device (skip after the last fold).
-        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
-        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+
+        # Rotate K/V to the next device — except after the last fold,
+        # where the rotated blocks would be discarded (saves one full
+        # K/V ICI hop per attention call). All devices see the same t, so
+        # the cond branches uniformly and the collective stays legal.
+        def rotate(blocks):
+            perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+            return tuple(
+                jax.lax.ppermute(b, axis_name, perm) for b in blocks
+            )
+
+        k_next, v_next = jax.lax.cond(
+            t + 1 < axis_size,
+            rotate,
+            lambda blocks: blocks,
+            (k_blk, v_blk),
+        )
         return m, l, acc, k_next, v_next
 
     m, l, acc, _, _ = jax.lax.fori_loop(

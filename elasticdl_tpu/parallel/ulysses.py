@@ -14,14 +14,17 @@ import functools
 
 import jax
 
-from elasticdl_tpu.ops.flash_attention import reference_attention
+from elasticdl_tpu.ops.flash_attention import flash_attention
 
 
 def ulysses_attention(q, k, v, axis_name, attention_fn=None, causal=False):
     """Call INSIDE shard_map with q/k/v local blocks [B, H, S_local, D].
     Requires num_heads % axis_size == 0."""
     if attention_fn is None:
-        attention_fn = functools.partial(reference_attention, causal=causal)
+        # Flash attention by default: the whole point of the re-shard is
+        # attending over S_global, and a full score matrix there is the
+        # quadratic memory this path exists to avoid.
+        attention_fn = functools.partial(flash_attention, causal=causal)
     axis_size = jax.lax.psum(1, axis_name)
     h = q.shape[1]
     if h % axis_size:

@@ -1,0 +1,23 @@
+"""Feature preprocessing for TPU pipelines.
+
+Counterpart of the reference's elasticdl_preprocessing package (11 Keras
+layers, /root/reference/elasticdl_preprocessing/layers/__init__.py).
+TPU-first redesign: XLA has no ragged/sparse tensors, so variable-length
+features travel as PADDED DENSE arrays + masks (see PaddedFeature); every
+transform is a pure function of dense arrays, traceable under jit and
+equally usable in numpy inside `feed`.
+"""
+
+from elasticdl_tpu.preprocessing.layers import (  # noqa: F401
+    ConcatenateWithOffset,
+    Discretization,
+    Hashing,
+    IndexLookup,
+    LogRound,
+    Normalizer,
+    PaddedFeature,
+    RoundIdentity,
+    SparseEmbedding,
+    ToNumber,
+    to_padded,
+)

@@ -1,0 +1,312 @@
+"""The 11 preprocessing transforms, as dense/padded-dense pure functions.
+
+Reference counterparts under /root/reference/elasticdl_preprocessing/layers/
+(per-class citations below). Sparse/Ragged input branches of the reference
+become the (values, mask) padded-dense form: XLA needs static shapes, so
+"missing" positions are padding ids masked out of combiners instead of
+absent coordinates.
+
+Every class is stateless and callable on numpy or jnp arrays; use them in
+`feed` (host-side, numpy) or inside flax modules (traced).
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+import flax.linen as nn
+
+
+@dataclasses.dataclass
+class PaddedFeature:
+    """Padded-dense stand-in for the reference's Sparse/RaggedTensor inputs:
+    `values` [batch, max_len] and boolean `mask` [batch, max_len] (True =
+    real element)."""
+
+    values: object
+    mask: object
+
+
+def to_padded(list_of_lists, max_len=None, pad_value=0, dtype=np.int64):
+    """The ToRagged/ToSparse analog (/root/reference/elasticdl_preprocessing/
+    layers/to_ragged.py, to_sparse.py): variable-length python/numpy rows ->
+    PaddedFeature with static [batch, max_len] shape."""
+    if max_len is None:
+        max_len = max((len(r) for r in list_of_lists), default=0) or 1
+    n = len(list_of_lists)
+    values = np.full((n, max_len), pad_value, dtype=dtype)
+    mask = np.zeros((n, max_len), dtype=bool)
+    for i, row in enumerate(list_of_lists):
+        row = list(row)[:max_len]
+        values[i, : len(row)] = row
+        mask[i, : len(row)] = True
+    return PaddedFeature(values=values, mask=mask)
+
+
+def _xp(x):
+    return jnp if isinstance(x, jnp.ndarray) else np
+
+
+def _map_values(fn, inputs):
+    if isinstance(inputs, PaddedFeature):
+        return PaddedFeature(values=fn(inputs.values), mask=inputs.mask)
+    return fn(inputs)
+
+
+class ToNumber:
+    """Strings/bytes -> numbers (reference to_number.py). Host-side only
+    (strings never reach the device)."""
+
+    def __init__(self, out_type=np.float32, default_value=0):
+        self.out_type = out_type
+        self.default_value = default_value
+
+    def __call__(self, inputs):
+        def convert(arr):
+            flat = []
+            for x in np.asarray(arr).reshape(-1):
+                if isinstance(x, bytes):
+                    x = x.decode("utf-8", "ignore")
+                try:
+                    flat.append(self.out_type(x))
+                except (TypeError, ValueError):
+                    flat.append(self.out_type(self.default_value))
+            return np.asarray(flat, self.out_type).reshape(
+                np.asarray(arr).shape
+            )
+
+        return _map_values(convert, inputs)
+
+
+class RoundIdentity:
+    """round() + clip to [0, num_buckets) (reference round_identity.py:18-61).
+    """
+
+    def __init__(self, num_buckets, default_value=0):
+        self.num_buckets = num_buckets
+        self.default_value = default_value
+
+    def __call__(self, inputs):
+        def fn(x):
+            xp = _xp(x)
+            out = xp.clip(xp.round(x), 0, self.num_buckets - 1)
+            return out.astype(xp.int64 if xp is np else jnp.int64)
+
+        return _map_values(fn, inputs)
+
+
+class LogRound:
+    """round(log_base(x)) clipped to [0, num_bins) (reference
+    log_round.py:29-75)."""
+
+    def __init__(self, num_bins, default_value=0, base=None):
+        self.num_bins = num_bins
+        self.base = base
+        self.default_value = default_value
+
+    def __call__(self, inputs):
+        def fn(x):
+            xp = _xp(x)
+            safe = xp.maximum(x, 1e-12)
+            logged = xp.log(safe)
+            if self.base is not None:
+                logged = logged / np.log(self.base)
+            out = xp.clip(xp.round(logged), 0, self.num_bins - 1)
+            return out.astype(xp.int64 if xp is np else jnp.int64)
+
+        return _map_values(fn, inputs)
+
+
+class Hashing:
+    """Deterministic hash of values into [0, num_bins) (reference
+    hashing.py: strings via to_hash_bucket_fast; here a splitmix64-style
+    integer mix, identical across host/device)."""
+
+    def __init__(self, num_bins):
+        if num_bins < 1:
+            raise ValueError("num_bins must be >= 1")
+        self.num_bins = num_bins
+
+    def __call__(self, inputs):
+        def fn(x):
+            if isinstance(x, np.ndarray) and x.dtype.kind in ("U", "S", "O"):
+                import hashlib
+
+                flat = np.asarray(
+                    [
+                        int.from_bytes(
+                            hashlib.sha256(
+                                (
+                                    s.decode("utf-8", "ignore")
+                                    if isinstance(s, bytes)
+                                    else str(s)
+                                ).encode("utf-8")
+                            ).digest()[:8],
+                            "little",
+                        )
+                        % self.num_bins
+                        for s in x.reshape(-1)
+                    ],
+                    np.int64,
+                )
+                return flat.reshape(x.shape)
+            xp = _xp(x)
+            # murmur3 fmix32 in uint32: identical on host numpy and on
+            # device (jax defaults to 32-bit ints; uint64 would silently
+            # truncate there). 64-bit host ids fold hi^lo into 32 bits
+            # first — same result for any id the device could represent.
+            if xp is np:
+                wide = x.astype(np.uint64)
+                z = ((wide & np.uint64(0xFFFFFFFF)) ^ (wide >> 32)).astype(
+                    np.uint32
+                )
+            else:
+                z = x.astype(jnp.uint32)
+            c1, c2 = np.uint32(0x85EBCA6B), np.uint32(0xC2B2AE35)
+            z = z ^ (z >> 16)
+            z = z * c1
+            z = z ^ (z >> 13)
+            z = z * c2
+            z = z ^ (z >> 16)
+            return (z % np.uint32(self.num_bins)).astype(
+                jnp.int32 if xp is jnp else np.int64
+            )
+
+        return _map_values(fn, inputs)
+
+
+class Discretization:
+    """Bucketize by boundaries: output in [0, len(bins)] (reference
+    discretization.py)."""
+
+    def __init__(self, bins):
+        self.bins = np.asarray(sorted(bins), np.float64)
+
+    def __call__(self, inputs):
+        def fn(x):
+            xp = _xp(x)
+            bins = self.bins if xp is np else jnp.asarray(self.bins)
+            out = (
+                np.digitize(x, bins)
+                if xp is np
+                else jnp.digitize(x, bins)
+            )
+            return out.astype(np.int64 if xp is np else jnp.int64)
+
+        return _map_values(fn, inputs)
+
+
+class IndexLookup:
+    """Vocabulary -> index; OOV maps to len(vocab) (reference
+    index_lookup.py: lookup table with num_oov_indices=1). Host-side (string
+    keys)."""
+
+    def __init__(self, vocabulary, num_oov_indices=1):
+        if isinstance(vocabulary, str):
+            with open(vocabulary) as f:
+                vocabulary = [line.rstrip("\n") for line in f if line.strip()]
+        self.vocab = {v: i for i, v in enumerate(vocabulary)}
+        self.num_oov_indices = max(1, num_oov_indices)
+
+    def vocab_size(self):
+        return len(self.vocab) + self.num_oov_indices
+
+    def __call__(self, inputs):
+        def fn(x):
+            arr = np.asarray(x)
+            oov_base = len(self.vocab)
+
+            def lookup(s):
+                if isinstance(s, bytes):
+                    s = s.decode("utf-8", "ignore")
+                idx = self.vocab.get(s)
+                if idx is None:
+                    idx = oov_base + (hash(s) % self.num_oov_indices)
+                return idx
+
+            return np.asarray(
+                [lookup(s) for s in arr.reshape(-1)], np.int64
+            ).reshape(arr.shape)
+
+        return _map_values(fn, inputs)
+
+
+class Normalizer:
+    """(x - subtractor) / divisor (reference normalizer.py; the analyzer
+    feeds mean/std or min/max from dataset statistics)."""
+
+    def __init__(self, subtractor, divisor):
+        self.subtractor = float(subtractor)
+        self.divisor = float(divisor) or 1.0
+
+    def __call__(self, inputs):
+        return _map_values(
+            lambda x: (x - self.subtractor) / self.divisor, inputs
+        )
+
+
+class ConcatenateWithOffset:
+    """Concatenate id features, offsetting each input so id spaces don't
+    collide (reference concatenate_with_offset.py). PaddedFeature inputs
+    concatenate values AND masks."""
+
+    def __init__(self, offsets, axis=-1):
+        self.offsets = list(offsets)
+        self.axis = axis
+
+    def __call__(self, inputs):
+        if len(self.offsets) != len(inputs):
+            raise ValueError(
+                f"{len(self.offsets)} offsets != {len(inputs)} inputs"
+            )
+        if isinstance(inputs[0], PaddedFeature):
+            xp = _xp(inputs[0].values)
+            values = xp.concatenate(
+                [
+                    f.values + off
+                    for f, off in zip(inputs, self.offsets)
+                ],
+                axis=self.axis,
+            )
+            mask = xp.concatenate(
+                [f.mask for f in inputs], axis=self.axis
+            )
+            return PaddedFeature(values=values, mask=mask)
+        xp = _xp(inputs[0])
+        return xp.concatenate(
+            [x + off for x, off in zip(inputs, self.offsets)],
+            axis=self.axis,
+        )
+
+
+class SparseEmbedding(nn.Module):
+    """Embedding over padded multivalent ids with masked combiner —
+    the reference's SparseEmbedding layer (sparse_embedding.py:20) on
+    padded-dense input. Trainable table in params (for the PS-resident
+    variant use layers.embedding.DistributedEmbedding)."""
+
+    vocab_size: int
+    dim: int
+    combiner: str = "sum"
+
+    @nn.compact
+    def __call__(self, feature: PaddedFeature):
+        table = self.param(
+            "table",
+            nn.initializers.uniform(scale=0.05),
+            (self.vocab_size, self.dim),
+        )
+        ids = jnp.asarray(feature.values).astype(jnp.int32)
+        mask = jnp.asarray(feature.mask)
+        emb = jnp.take(table, ids, axis=0)  # [B, L, D]
+        emb = emb * mask[..., None]
+        total = jnp.sum(emb, axis=-2)
+        count = jnp.maximum(jnp.sum(mask, axis=-1, keepdims=True), 1)
+        if self.combiner == "sum":
+            return total
+        if self.combiner == "mean":
+            return total / count
+        if self.combiner == "sqrtn":
+            return total / jnp.sqrt(count.astype(total.dtype))
+        raise ValueError(f"unknown combiner {self.combiner!r}")

@@ -1,0 +1,112 @@
+"""Preprocessing layer tests mirroring the reference's examples
+(/root/reference/elasticdl_preprocessing/layers/*.py docstrings)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from elasticdl_tpu.preprocessing.layers import (
+    ConcatenateWithOffset,
+    Discretization,
+    Hashing,
+    IndexLookup,
+    LogRound,
+    Normalizer,
+    RoundIdentity,
+    SparseEmbedding,
+    ToNumber,
+    to_padded,
+)
+
+
+def test_round_identity():
+    # Reference round_identity.py example: [[1.2],[1.6],[0.2],[3.1],[4.9]]
+    # -> [[1],[2],[0],[3],[5]]
+    layer = RoundIdentity(num_buckets=6)
+    out = layer(np.asarray([[1.2], [1.6], [0.2], [3.1], [4.9]]))
+    np.testing.assert_array_equal(out, [[1], [2], [0], [3], [5]])
+    assert out.dtype == np.int64
+
+
+def test_log_round():
+    # Reference log_round.py example (base=2): [[1.2],[1.6],[0.2],[3.1],
+    # [100]] -> [[0],[1],[0],[2],[7]]
+    layer = LogRound(num_bins=16, base=2)
+    out = layer(np.asarray([[1.2], [1.6], [0.2], [3.1], [100.0]]))
+    np.testing.assert_array_equal(out, [[0], [1], [0], [2], [7]])
+
+
+def test_discretization():
+    layer = Discretization(bins=[10, 20, 30])
+    out = layer(np.asarray([[5.0], [12.0], [25.0], [99.0]]))
+    np.testing.assert_array_equal(out, [[0], [1], [2], [3]])
+
+
+def test_hashing_deterministic_and_in_range():
+    layer = Hashing(num_bins=7)
+    ids = np.arange(1000, dtype=np.int64)
+    out1 = layer(ids)
+    out2 = layer(ids)
+    np.testing.assert_array_equal(out1, out2)
+    assert out1.min() >= 0 and out1.max() < 7
+    # Host/device parity: numpy and jnp inputs hash identically.
+    out_j = np.asarray(layer(jnp.asarray(ids)))
+    np.testing.assert_array_equal(out1, out_j)
+    # Strings hash too.
+    s = layer(np.asarray(["a", "b", "a"]))
+    assert s[0] == s[2]
+
+
+def test_index_lookup_with_oov():
+    layer = IndexLookup(vocabulary=["apple", "banana"])
+    out = layer(np.asarray([["apple"], ["banana"], ["durian"]]))
+    np.testing.assert_array_equal(out[:2], [[0], [1]])
+    assert out[2, 0] == 2  # OOV bucket
+    assert layer.vocab_size() == 3
+
+
+def test_normalizer():
+    layer = Normalizer(subtractor=10.0, divisor=2.0)
+    np.testing.assert_allclose(
+        layer(np.asarray([12.0, 8.0])), [1.0, -1.0]
+    )
+
+
+def test_to_number():
+    layer = ToNumber(out_type=np.float32, default_value=-1)
+    out = layer(np.asarray([["1.5"], [b"2"], ["oops"]], dtype=object))
+    np.testing.assert_allclose(out, [[1.5], [2.0], [-1.0]])
+
+
+def test_to_padded_and_concatenate_with_offset():
+    f1 = to_padded([[1, 2], [3]], max_len=2)
+    f2 = to_padded([[0], [1, 1]], max_len=2)
+    np.testing.assert_array_equal(f1.values, [[1, 2], [3, 0]])
+    np.testing.assert_array_equal(f1.mask, [[True, True], [True, False]])
+    merged = ConcatenateWithOffset(offsets=[0, 10])([f1, f2])
+    np.testing.assert_array_equal(
+        merged.values, [[1, 2, 10, 10], [3, 0, 11, 11]]
+    )
+    assert merged.mask.shape == (2, 4)
+
+
+def test_sparse_embedding_combiners_mask_padding():
+    feature = to_padded([[1, 2], [3]], max_len=2)
+    for combiner, expect_fn in (
+        ("sum", lambda t: t[1] + t[2]),
+        ("mean", lambda t: (t[1] + t[2]) / 2),
+        ("sqrtn", lambda t: (t[1] + t[2]) / np.sqrt(2)),
+    ):
+        layer = SparseEmbedding(vocab_size=8, dim=4, combiner=combiner)
+        variables = layer.init(jax.random.PRNGKey(0), feature)
+        table = np.asarray(variables["params"]["table"])
+        out = np.asarray(layer.apply(variables, feature))
+        np.testing.assert_allclose(
+            out[0], expect_fn(table), rtol=1e-5
+        )
+        # Row 1 has one real id (3); padding row 0 must not leak in.
+        np.testing.assert_allclose(
+            out[1],
+            table[3] / (np.sqrt(1) if combiner != "mean" else 1),
+            rtol=1e-5,
+        )
