@@ -11,11 +11,26 @@ Every class is stateless and callable on numpy or jnp arrays; use them in
 """
 
 import dataclasses
+import hashlib
 
 import jax.numpy as jnp
 import numpy as np
 
 import flax.linen as nn
+
+
+def _stable_hash64(s) -> int:
+    """Process-independent 64-bit hash of a string/bytes token. Used for
+    every host-side string bucketing decision (Hashing, IndexLookup OOV) so
+    the same token lands in the same bucket on every worker and across
+    restarts — Python's builtin hash() is randomized per process."""
+    if isinstance(s, bytes):
+        s = s.decode("utf-8", "ignore")
+    elif not isinstance(s, str):
+        s = str(s)
+    return int.from_bytes(
+        hashlib.sha256(s.encode("utf-8")).digest()[:8], "little"
+    )
 
 
 @dataclasses.dataclass
@@ -131,21 +146,9 @@ class Hashing:
     def __call__(self, inputs):
         def fn(x):
             if isinstance(x, np.ndarray) and x.dtype.kind in ("U", "S", "O"):
-                import hashlib
-
                 flat = np.asarray(
                     [
-                        int.from_bytes(
-                            hashlib.sha256(
-                                (
-                                    s.decode("utf-8", "ignore")
-                                    if isinstance(s, bytes)
-                                    else str(s)
-                                ).encode("utf-8")
-                            ).digest()[:8],
-                            "little",
-                        )
-                        % self.num_bins
+                        _stable_hash64(s) % self.num_bins
                         for s in x.reshape(-1)
                     ],
                     np.int64,
@@ -222,7 +225,9 @@ class IndexLookup:
                     s = s.decode("utf-8", "ignore")
                 idx = self.vocab.get(s)
                 if idx is None:
-                    idx = oov_base + (hash(s) % self.num_oov_indices)
+                    idx = oov_base + (
+                        _stable_hash64(s) % self.num_oov_indices
+                    )
                 return idx
 
             return np.asarray(

@@ -43,12 +43,22 @@ class CheckpointSaver:
         self._keep_max = keep_checkpoint_max
         os.makedirs(checkpoint_dir, exist_ok=True)
 
+    def snapshot(self, version, parameters):
+        """Serialize a consistent Model pb of the store. Callers that share
+        the store with concurrent writers must hold the version lock here
+        (and may release it before save_snapshot, which only does I/O)."""
+        model = parameters.to_model_pb(include_embeddings=True)
+        model.version = version
+        return model
+
     def save(self, version, parameters):
+        """Snapshot + write in one call (single-writer callers only)."""
+        self.save_snapshot(version, self.snapshot(version, parameters))
+
+    def save_snapshot(self, version, model):
         """Write this shard's file for `version` (atomic rename), then GC."""
         os.makedirs(_version_dir(self._dir, version), exist_ok=True)
         path = _shard_path(self._dir, version, self._ps_id, self._num_ps)
-        model = parameters.to_model_pb(include_embeddings=True)
-        model.version = version
         tmp = f"{path}.tmp"
         with open(tmp, "wb") as f:
             f.write(model.SerializeToString())

@@ -61,6 +61,7 @@ class PSOptimizer:
         self._name = spec.name
         self._dense_slots = {}
         self._step = 0  # global step for Adam bias correction
+        self._apply_step = None  # step shared by all params of one push
         self._step_lock = threading.Lock()
         self.lr_modulator = LearningRateModulator()
         slots = dict(self._SLOTS[self._name])
@@ -74,7 +75,27 @@ class PSOptimizer:
     def spec(self):
         return self._spec
 
-    def _next_step(self):
+    def begin_apply(self):
+        """Advance the global step once per gradient push; every parameter
+        applied in that push shares it (the reference increments once per
+        push with all params sharing the step, go/pkg/ps/optimizer.go:44).
+        Callers (the servicer) hold the version lock across the whole push,
+        so a plain attribute is race-free."""
+        with self._step_lock:
+            self._step += 1
+            self._apply_step = self._step
+            return self._apply_step
+
+    def end_apply(self):
+        """Close the push opened by begin_apply; standalone apply_* calls
+        (unit tests) return to bump-per-call stepping."""
+        self._apply_step = None
+
+    def _cur_step(self):
+        if self._apply_step is not None:
+            return self._apply_step
+        # Standalone apply_* call without begin_apply (unit tests): keep the
+        # old bump-per-call behavior.
         with self._step_lock:
             self._step += 1
             return self._step
@@ -123,7 +144,7 @@ class PSOptimizer:
             )
             lib.edl_adam(
                 g, p, native._f32p(m), native._f32p(v), ms, lr,
-                self._next_step(), self._h["beta_1"], self._h["beta_2"],
+                self._cur_step(), self._h["beta_1"], self._h["beta_2"],
                 self._h["epsilon"], n,
             )
         elif self._name == "adagrad":
@@ -168,7 +189,7 @@ class PSOptimizer:
                 )
                 lib.edl_adam_indexed(
                     g, r, k, dim, slab, native._f32p(m), native._f32p(v),
-                    ms, lr, self._next_step(), self._h["beta_1"],
+                    ms, lr, self._cur_step(), self._h["beta_1"],
                     self._h["beta_2"], self._h["epsilon"],
                 )
             elif self._name == "adagrad":
@@ -185,7 +206,7 @@ class PSOptimizer:
     # ---------- numpy fallbacks (EDL_NO_NATIVE=1 or no toolchain) ----------
 
     def _apply_dense_numpy(self, name, param, grad, lr):
-        step = self._next_step() if self._name == "adam" else 0
+        step = self._cur_step() if self._name == "adam" else 0
         self._numpy_rule(
             param.reshape(-1), grad.reshape(-1), lr, step,
             lambda slot, init: self._dense_slot(
@@ -195,7 +216,7 @@ class PSOptimizer:
 
     def _apply_sparse_numpy(self, table, rows, grads, lr):
         # One global Adam step per push, matching the native indexed kernel.
-        step = self._next_step() if self._name == "adam" else 0
+        step = self._cur_step() if self._name == "adam" else 0
         for j, row in enumerate(rows):
             self._numpy_rule(
                 table.slab[row], grads[j], lr, step,

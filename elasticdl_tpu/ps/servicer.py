@@ -130,7 +130,8 @@ class PserverServicer:
             self._apply_model_pb(request.gradients)
             self._params.version += 1
             version = self._params.version
-        self._post_apply(version)
+            snapshot = self._snapshot_if_due(version)
+        self._post_apply(version, snapshot)
         return pb.PushGradientsResponse(accepted=True, version=version)
 
     # ---------- sync path ----------
@@ -163,51 +164,84 @@ class PserverServicer:
                     accepted=True, version=self._params.version
                 )
             # Quorum reached: average dense, merge sparse, apply once.
-            for name, g in self._grad_sum.items():
-                self._opt.apply_dense(
-                    name, self._params.dense[name], g / self._grad_n
-                )
-            for name, (values_list, ids_list) in self._sparse_acc.items():
-                values, ids = tensor_utils.merge_indexed_slices(
-                    values_list, ids_list
-                )
-                values /= self._grad_n
-                self._opt.apply_sparse(
-                    self._params.embedding_tables[name], ids, values
-                )
+            self._opt.begin_apply()
+            try:
+                for name, g in self._grad_sum.items():
+                    self._opt.apply_dense(
+                        name, self._params.dense[name], g / self._grad_n
+                    )
+                for name, (values_list, ids_list) in self._sparse_acc.items():
+                    values, ids = tensor_utils.merge_indexed_slices(
+                        values_list, ids_list
+                    )
+                    values /= self._grad_n
+                    self._opt.apply_sparse(
+                        self._params.embedding_tables[name], ids, values
+                    )
+            finally:
+                self._opt.end_apply()
             self._grad_sum.clear()
             self._sparse_acc.clear()
             self._grad_n = 0
             self._params.version += 1
             version = self._params.version
-        self._post_apply(version)
+            snapshot = self._snapshot_if_due(version)
+        self._post_apply(version, snapshot)
         return pb.PushGradientsResponse(accepted=True, version=version)
 
     # ---------- shared ----------
 
     def _apply_model_pb(self, gradients):
-        for t in gradients.dense_parameters:
-            param = self._params.dense.get(t.name)
-            if param is None:
-                raise ValueError(f"gradient for unknown parameter {t.name!r}")
-            self._opt.apply_dense(
-                t.name, param, tensor_utils.tensor_pb_to_ndarray(t)
-            )
-        for name, slices in gradients.embedding_tables.items():
-            table = self._params.embedding_tables.get(name)
-            if table is None:
-                raise ValueError(f"gradient for unknown table {name!r}")
-            values, ids = tensor_utils.indexed_slices_pb_to_ndarrays(slices)
-            self._opt.apply_sparse(table, ids, values)
+        # One optimizer step for the whole push: all params share the same
+        # Adam bias-correction step (reference go/pkg/ps/optimizer.go:44).
+        self._opt.begin_apply()
+        try:
+            for t in gradients.dense_parameters:
+                param = self._params.dense.get(t.name)
+                if param is None:
+                    raise ValueError(
+                        f"gradient for unknown parameter {t.name!r}"
+                    )
+                self._opt.apply_dense(
+                    t.name, param, tensor_utils.tensor_pb_to_ndarray(t)
+                )
+            for name, slices in gradients.embedding_tables.items():
+                table = self._params.embedding_tables.get(name)
+                if table is None:
+                    raise ValueError(f"gradient for unknown table {name!r}")
+                values, ids = tensor_utils.indexed_slices_pb_to_ndarrays(
+                    slices
+                )
+                self._opt.apply_sparse(table, ids, values)
+        finally:
+            self._opt.end_apply()
 
-    def _post_apply(self, version):
+    def _snapshot_if_due(self, version):
+        """Call under _version_lock. Serializes a consistent snapshot of the
+        store when a checkpoint is due; concurrent pushes mutate the dense
+        numpy arrays in place through GIL-releasing native kernels, so
+        snapshotting outside the lock could serialize torn, mixed-version
+        tensors (the reference saves inside the version lock,
+        python/ps/servicer.py:157-159). The (slow) file write itself happens
+        after the lock is released, in _post_apply."""
         if (
             self._checkpoint_saver is not None
             and self._checkpoint_steps
             and version % self._checkpoint_steps == 0
         ):
             try:
-                self._checkpoint_saver.save(version, self._params)
+                return self._checkpoint_saver.snapshot(version, self._params)
+            except Exception:
+                logger.error(
+                    "Checkpoint snapshot at version %d failed",
+                    version, exc_info=True,
+                )
+        return None
+
+    def _post_apply(self, version, snapshot=None):
+        if snapshot is not None:
+            try:
+                self._checkpoint_saver.save_snapshot(version, snapshot)
             except Exception:
                 logger.error(
                     "Checkpoint at version %d failed", version, exc_info=True

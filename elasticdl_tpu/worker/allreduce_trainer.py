@@ -67,6 +67,9 @@ class AllReduceTrainer(JaxTrainer):
         seed=0,
     ):
         super().__init__(model, loss_fn, optimizer_spec, seed=seed)
+        self._step_rng_base = jax.random.fold_in(
+            jax.random.PRNGKey(seed), 0x5EED
+        )
         self._mc = master_client
         self._steps_per_world_check = steps_per_world_check
         self._max_comm_retries = max_comm_retries
@@ -109,11 +112,22 @@ class AllReduceTrainer(JaxTrainer):
         with self._state_lock:
             if self._variables is None:
                 return None
-            return (
-                jax.device_get(self._variables),
-                jax.device_get(self._opt_state),
-                self._version,
-            )
+            try:
+                return (
+                    jax.device_get(self._variables),
+                    jax.device_get(self._opt_state),
+                    self._version,
+                )
+            except Exception:
+                # Device arrays poisoned by an async collective failure:
+                # treat local state as lost. Regroup then falls back to a
+                # rank-0 pull (or data re-seed), instead of crashing the
+                # recovery path itself.
+                logger.warning(
+                    "Local state unreadable (poisoned by a failed step); "
+                    "discarding for recovery", exc_info=True,
+                )
+                return None
 
     # ---------- world management ----------
 
@@ -243,7 +257,8 @@ class AllReduceTrainer(JaxTrainer):
     def train_minibatch(self, features, labels):
         self.init_variables_if_needed(features)
         self._steps_since_check += 1
-        if self._steps_since_check >= self._steps_per_world_check:
+        sync_step = self._steps_since_check >= self._steps_per_world_check
+        if sync_step:
             self._steps_since_check = 0
             self.init_world_if_needed()
         features = jax.tree_util.tree_map(np.asarray, features)
@@ -251,7 +266,15 @@ class AllReduceTrainer(JaxTrainer):
         for attempt in range(self._max_comm_retries):
             try:
                 loss = self._run_sharded_step(features, labels)
-                return True, self._version, float(loss)
+                if sync_step:
+                    # Async dispatch means a collective failure surfaces on
+                    # materialization, not dispatch. Block here — on the
+                    # same cadence as the world check, which already costs
+                    # a host round trip — so comm errors land inside this
+                    # try block and the re-mesh/retry path below runs,
+                    # instead of exploding later at a logging float().
+                    jax.block_until_ready(loss)
+                return True, self._version, loss
             except RETRYABLE_ERRORS:
                 if attempt == self._max_comm_retries - 1:
                     raise
@@ -269,7 +292,13 @@ class AllReduceTrainer(JaxTrainer):
         padded_l, _ = pad_batch_to_multiple(labels, n_data)
         padded_n = jax.tree_util.tree_leaves(padded_f)[0].shape[0]
         step = self._sharded_step_for(real_n, padded_n)
-        self._rng, step_rng = jax.random.split(self._rng)
+        # Derive the dropout key from the SHARED model version, not a local
+        # split chain: a joining worker's split count differs from the
+        # incumbents', and in multi-host runs the step rng is a replicated
+        # jit input that must be bit-identical across processes. version is
+        # part of the rank-0 broadcast state, so fold_in(base, version) is
+        # history-independent and agrees everywhere.
+        step_rng = jax.random.fold_in(self._step_rng_base, self._version)
         with self._mesh:
             new_variables, new_opt_state, loss = step(
                 self._variables,

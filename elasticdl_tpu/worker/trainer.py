@@ -29,7 +29,12 @@ class Trainer(ABC):
 
     @abstractmethod
     def train_minibatch(self, features, labels):
-        """Returns (accepted: bool, model_version: int, loss: float)."""
+        """Returns (accepted: bool, model_version: int, loss).
+
+        `loss` is a float-convertible scalar. On-device strategies return a
+        lazy jax array so the host never blocks on the step; callers must
+        only materialize it (float()) when they actually log it, keeping
+        steps dispatch-ahead on TPU."""
 
     @abstractmethod
     def evaluate_minibatch(self, features, model_version=-1):
@@ -173,7 +178,9 @@ class JaxTrainer(Trainer):
             _to_device_batch(labels),
         )
         self._version += 1
-        return True, self._version, float(loss)
+        # Lazy device scalar: converting to float here would block the host
+        # on every step and serialize dispatch (the round-1 bench ceiling).
+        return True, self._version, loss
 
     def evaluate_minibatch(self, features, model_version=-1):
         self.init_variables_if_needed(features)

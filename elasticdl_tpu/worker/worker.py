@@ -160,11 +160,13 @@ class Worker:
         if accepted:
             self._steps += 1
             if self._steps % self._log_loss_steps == 0:
+                # Only materialize the (lazy, on-device) loss when logging;
+                # every other step stays dispatch-ahead.
                 logger.info(
                     "Step %d (version %d) loss %.6f",
                     self._steps,
                     version,
-                    loss,
+                    float(loss),
                 )
 
     def _process_eval_batch(self, records):
