@@ -1,57 +1,160 @@
 """Benchmark entrypoint: prints ONE JSON line
-{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "details": {...}}.
 
-Measures training throughput (examples/sec/chip) of the current flagship
-model on the available device. Baseline comparison: the reference's best
-published single-accelerator number for an image CNN — ResNet50/ImageNet on
-one P100 at 145 img/s (BASELINE.md, ftlib_benchmark.md:114-135). Models are
-not identical across frameworks, so vs_baseline is a coarse chips-vs-GPUs
-throughput ratio until the resnet50 zoo config lands.
+North-star configs (BASELINE.json): ResNet50-ImageNet and DeepFM-Criteo
+examples/sec/chip. The primary metric is ResNet50 train throughput per chip
+(bf16, synthetic ImageNet shapes, batch 128) against the reference's best
+published single-accelerator figure — 145 img/s on one P100
+(BASELINE.md, ftlib_benchmark.md:114-135). details carries step time, an
+MFU estimate from XLA's own cost analysis, and the DeepFM-Criteo number.
+
+Method: the batch is placed on device once and the jitted train step runs
+in a loop with donated buffers (synthetic-data-resident mode, as in MLPerf
+synthetic runs) — measuring the training step, not host dataloading.
 """
 
 import json
+import os
 import time
 
 import jax
 import numpy as np
 
+# Peak dense bf16 FLOP/s by device kind (public spec sheets), for the MFU
+# denominator. Override with EDL_PEAK_TFLOPS for unlisted hardware.
+PEAK_TFLOPS_BY_KIND = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5": 459.0,
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
 
-def bench_train_throughput(batch_size=256, steps=30, warmup=5):
+
+def _peak_flops():
+    env = os.environ.get("EDL_PEAK_TFLOPS")
+    if env:
+        return float(env) * 1e12
+    kind = jax.devices()[0].device_kind
+    tflops = PEAK_TFLOPS_BY_KIND.get(kind)
+    return tflops * 1e12 if tflops else None
+
+
+def _time_step_loop(trainer, features, labels, steps, warmup):
+    """Build the trainer's jitted step, park the batch on device, loop with
+    donated buffers. Returns (elapsed_s, flops_per_step or None)."""
+    trainer.init_variables_if_needed(features)
+    step = trainer._train_step
+    variables, opt_state = trainer._variables, trainer._opt_state
+    rng = jax.random.PRNGKey(0)
+    dev_f = jax.device_put(features)
+    dev_l = jax.device_put(labels)
+
+    flops = None
+    try:
+        cost = step.lower(
+            variables, opt_state, rng, dev_f, dev_l
+        ).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0)) or None
+    except Exception:
+        pass
+
+    for _ in range(warmup):
+        variables, opt_state, loss = step(
+            variables, opt_state, rng, dev_f, dev_l
+        )
+    # On tunneled device platforms block_until_ready can return at dispatch;
+    # a scalar host read is the only sync that provably waits for execution.
+    float(loss)
+
+    start = time.perf_counter()
+    for _ in range(steps):
+        variables, opt_state, loss = step(
+            variables, opt_state, rng, dev_f, dev_l
+        )
+    float(loss)  # force completion of the whole chain (4-byte transfer)
+    return time.perf_counter() - start, flops
+
+
+def bench_resnet50(batch_size=128, steps=30, warmup=5):
     from elasticdl_tpu.common.model_utils import get_model_spec
     from elasticdl_tpu.worker.trainer import LocalTrainer
 
-    spec = get_model_spec("elasticdl_tpu.models.mnist.mnist_model")
+    spec = get_model_spec("elasticdl_tpu.models.resnet50.resnet50")
     trainer = LocalTrainer(
         spec.build_model(), spec.loss, spec.build_optimizer_spec()
     )
     rng = np.random.default_rng(0)
-    features = rng.normal(size=(batch_size, 28, 28)).astype(np.float32)
-    labels = rng.integers(0, 10, batch_size).astype(np.int64)
+    features = rng.normal(size=(batch_size, 224, 224, 3)).astype(np.float32)
+    labels = rng.integers(0, 1000, batch_size).astype(np.int64)
+    elapsed, flops = _time_step_loop(trainer, features, labels, steps, warmup)
+    out = {
+        "examples_per_sec": batch_size * steps / elapsed,
+        "step_time_ms": elapsed / steps * 1e3,
+    }
+    if flops:
+        out["model_tflops_per_sec"] = flops * steps / elapsed / 1e12
+        peak = _peak_flops()
+        if peak:
+            out["mfu"] = flops * steps / elapsed / peak
+    return out
 
-    for _ in range(warmup):
-        trainer.train_minibatch(features, labels)
-    jax.block_until_ready(trainer._variables)
 
-    start = time.perf_counter()
-    for _ in range(steps):
-        trainer.train_minibatch(features, labels)
-    jax.block_until_ready(trainer._variables)
-    elapsed = time.perf_counter() - start
-    return batch_size * steps / elapsed
+def bench_deepfm_criteo(batch_size=8192, steps=30, warmup=5):
+    from elasticdl_tpu.common.model_utils import get_model_spec
+    from elasticdl_tpu.models.dac_ctr.transform import NUM_FIELDS, TOTAL_IDS
+    from elasticdl_tpu.worker.trainer import LocalTrainer
+
+    spec = get_model_spec("elasticdl_tpu.models.dac_ctr.deepfm")
+    trainer = LocalTrainer(
+        spec.build_model(), spec.loss, spec.build_optimizer_spec()
+    )
+    rng = np.random.default_rng(0)
+    features = {
+        "dense": rng.normal(size=(batch_size, 13)).astype(np.float32),
+        "ids": rng.integers(
+            0, TOTAL_IDS, size=(batch_size, NUM_FIELDS)
+        ).astype(np.int32),
+    }
+    labels = rng.integers(0, 2, batch_size).astype(np.int64)
+    elapsed, _ = _time_step_loop(trainer, features, labels, steps, warmup)
+    return {
+        "examples_per_sec": batch_size * steps / elapsed,
+        "step_time_ms": elapsed / steps * 1e3,
+    }
 
 
 def main():
-    examples_per_sec = bench_train_throughput()
-    n_devices = max(jax.local_device_count(), 1)
-    per_chip = examples_per_sec / n_devices
+    resnet = bench_resnet50()
+    deepfm = bench_deepfm_criteo()
+    # LocalTrainer's jitted step runs on exactly one device, so its
+    # examples/sec IS the per-chip figure regardless of how many chips the
+    # host exposes.
+    per_chip = resnet["examples_per_sec"]
     baseline_img_per_sec = 145.0  # reference ResNet50/ImageNet, 1x P100
+    details = {
+        "resnet50": {k: round(v, 4) for k, v in resnet.items()},
+        "deepfm_criteo": {k: round(v, 4) for k, v in deepfm.items()},
+        "deepfm_examples_per_sec_chip": round(
+            deepfm["examples_per_sec"], 2
+        ),
+        "device_kind": jax.devices()[0].device_kind,
+        "n_devices": max(jax.local_device_count(), 1),
+    }
     print(
         json.dumps(
             {
-                "metric": "examples/sec/chip (MnistCNN train step, batch 256)",
+                "metric": (
+                    "examples/sec/chip (ResNet50, bf16, 224x224, batch 128)"
+                ),
                 "value": round(per_chip, 2),
                 "unit": "examples/sec",
                 "vs_baseline": round(per_chip / baseline_img_per_sec, 3),
+                "details": details,
             }
         )
     )

@@ -1,0 +1,5 @@
+"""Criteo display-ads CTR model family (wide&deep / deepfm / dcn / xdeepfm).
+
+Reference counterpart: /root/reference/model_zoo/dac_ctr/ — the reference's
+north-star sparse benchmark (BASELINE.json: DeepFM-Criteo examples/sec/chip).
+"""

@@ -1,0 +1,94 @@
+"""Shared flax pieces for the dac_ctr family.
+
+Reference counterpart: /root/reference/model_zoo/dac_ctr/utils.py (DNN layer
++ lookup_embedding_func building one Keras Embedding per group). TPU-first:
+one wide table [V,1] and one deep table [V,D] over the shared offset id
+space; a single take per table serves all 39 fields, and per-field sums
+(when a group has several columns) fold into the same gather.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.evaluation_utils import AUCMetric, MeanMetric
+from elasticdl_tpu.models.dac_ctr.transform import TOTAL_IDS
+
+
+class DNN(nn.Module):
+    hidden_units: tuple = (16, 4)
+
+    @nn.compact
+    def __call__(self, x):
+        for width in self.hidden_units:
+            x = nn.relu(nn.Dense(width)(x))
+        return x
+
+
+class CTREmbeddings(nn.Module):
+    """wide [V,1] + deep [V,D] tables over the shared offset vocabulary.
+
+    Returns (linear_logits [B, F(+1)], field_embs [B, F, D], dense [B, 13]):
+    everything any head (wide&deep / FM / CIN / cross) consumes.
+    """
+
+    deep_dim: int = 8
+    vocab: int = TOTAL_IDS
+
+    @nn.compact
+    def __call__(self, features):
+        ids = features["ids"].astype(jnp.int32)  # [B, F]
+        dense = features["dense"].astype(jnp.float32)  # [B, 13]
+        wide_table = self.param(
+            "wide", nn.initializers.zeros, (self.vocab, 1)
+        )
+        deep_table = self.param(
+            "deep",
+            nn.initializers.normal(stddev=0.01),
+            (self.vocab, self.deep_dim),
+        )
+        linear = jnp.take(wide_table, ids, axis=0)[..., 0]  # [B, F]
+        field_embs = jnp.take(deep_table, ids, axis=0)  # [B, F, D]
+        dense_logit = nn.Dense(1, use_bias=False, name="dense_linear")(
+            dense
+        )  # [B, 1]
+        linear_logits = jnp.concatenate([linear, dense_logit], axis=1)
+        return linear_logits, field_embs, dense
+
+
+def fm_interaction(field_embs):
+    """Second-order FM term via the (sum^2 - sum of squares)/2 identity:
+    [B, F, D] -> [B]."""
+    sum_sq = jnp.square(jnp.sum(field_embs, axis=1))
+    sq_sum = jnp.sum(jnp.square(field_embs), axis=1)
+    return 0.5 * jnp.sum(sum_sq - sq_sum, axis=1)
+
+
+def ctr_loss(labels, logits):
+    return jnp.mean(
+        optax.sigmoid_binary_cross_entropy(
+            logits.reshape(-1), labels.reshape(-1).astype(jnp.float32)
+        )
+    )
+
+
+class _LogitAUC(AUCMetric):
+    """AUCMetric over fixed [0,1] thresholds, fed raw logits: squash first."""
+
+    def update(self, outputs, labels):
+        probs = 1.0 / (1.0 + np.exp(-np.asarray(outputs, np.float64)))
+        super().update(probs, labels)
+
+
+def ctr_metrics():
+    return {
+        "auc": _LogitAUC(),
+        "accuracy": MeanMetric(
+            lambda outputs, labels: (
+                (np.asarray(outputs).reshape(-1) > 0)
+                == np.asarray(labels).reshape(-1).astype(bool)
+            ).astype(np.float64)
+        ),
+    }
+

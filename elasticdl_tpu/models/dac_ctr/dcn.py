@@ -1,0 +1,71 @@
+"""Criteo Deep & Cross (DCN) variant.
+
+Reference counterpart: /root/reference/model_zoo/dac_ctr/dcn_model.py
+(cross layers over the concatenated [dense, field-embedding] vector plus a
+deep tower). The cross layer keeps the standard rank-1 form
+x_{l+1} = x_0 * (x_l . w_l) + b_l + x_l — elementwise + one dot, which XLA
+fuses into a couple of MXU/VPU ops.
+"""
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+from elasticdl_tpu.models.dac_ctr.common import (
+    CTREmbeddings,
+    DNN,
+    ctr_loss,
+    ctr_metrics,
+)
+from elasticdl_tpu.models.dac_ctr.transform import feed  # noqa: F401
+from elasticdl_tpu.ops import optimizers
+
+
+class CrossNetwork(nn.Module):
+    num_layers: int = 3
+
+    @nn.compact
+    def __call__(self, x0):
+        x = x0
+        dim = x0.shape[-1]
+        for i in range(self.num_layers):
+            w = self.param(
+                f"w{i}", nn.initializers.normal(stddev=0.01), (dim,)
+            )
+            b = self.param(f"b{i}", nn.initializers.zeros, (dim,))
+            x = x0 * jnp.dot(x, w)[:, None] + b + x
+        return x
+
+
+class DCN(nn.Module):
+    deep_dim: int = 8
+    num_cross_layers: int = 3
+    dnn_hidden_units: tuple = (16, 4)
+
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        linear_logits, field_embs, dense = CTREmbeddings(
+            deep_dim=self.deep_dim
+        )(features)
+        x0 = jnp.concatenate(
+            [dense, field_embs.reshape(field_embs.shape[0], -1)], axis=1
+        )
+        cross_out = CrossNetwork(self.num_cross_layers)(x0)
+        deep_out = DNN(self.dnn_hidden_units)(x0)
+        head = jnp.concatenate([cross_out, deep_out], axis=1)
+        logit = nn.Dense(1, use_bias=False)(head).reshape(-1)
+        return jnp.sum(linear_logits, axis=1) + logit
+
+
+def custom_model():
+    return DCN()
+
+
+loss = ctr_loss
+
+
+def optimizer(lr=0.001):
+    return optimizers.adam(learning_rate=lr)
+
+
+def eval_metrics_fn():
+    return ctr_metrics()

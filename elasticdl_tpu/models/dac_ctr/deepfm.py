@@ -1,0 +1,56 @@
+"""Criteo DeepFM variant — the BASELINE.json sparse north-star config.
+
+Reference counterpart: /root/reference/model_zoo/dac_ctr/
+deepfm_model.py:20-109 (linear + FM over field embeddings + DNN). The FM
+second-order term uses the (sum^2 - sum-of-squares)/2 identity — one fused
+elementwise expression under XLA.
+"""
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+from elasticdl_tpu.models.dac_ctr.common import (
+    CTREmbeddings,
+    DNN,
+    ctr_loss,
+    ctr_metrics,
+    fm_interaction,
+)
+from elasticdl_tpu.models.dac_ctr.transform import feed  # noqa: F401
+from elasticdl_tpu.ops import optimizers
+
+
+class DeepFMCriteo(nn.Module):
+    deep_dim: int = 8
+    dnn_hidden_units: tuple = (16, 4)
+
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        linear_logits, field_embs, dense = CTREmbeddings(
+            deep_dim=self.deep_dim
+        )(features)
+        fm = fm_interaction(field_embs)  # [B]
+        dnn_input = jnp.concatenate(
+            [dense, field_embs.reshape(field_embs.shape[0], -1)], axis=1
+        )
+        dnn_logit = nn.Dense(1, use_bias=False)(
+            DNN(self.dnn_hidden_units)(dnn_input)
+        )
+        return (
+            jnp.sum(linear_logits, axis=1) + fm + dnn_logit.reshape(-1)
+        )
+
+
+def custom_model():
+    return DeepFMCriteo()
+
+
+loss = ctr_loss
+
+
+def optimizer(lr=0.001):
+    return optimizers.adam(learning_rate=lr)
+
+
+def eval_metrics_fn():
+    return ctr_metrics()

@@ -1,0 +1,52 @@
+"""Criteo wide & deep variant.
+
+Reference counterpart: /root/reference/model_zoo/dac_ctr/
+wide_deep_model.py:20-107 (wide = dim-1 embeddings + dense linear; deep =
+field embeddings + standardized dense through a DNN; logits = sum of parts).
+"""
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+from elasticdl_tpu.models.dac_ctr.common import (
+    CTREmbeddings,
+    DNN,
+    ctr_loss,
+    ctr_metrics,
+)
+from elasticdl_tpu.models.dac_ctr.transform import feed  # noqa: F401
+from elasticdl_tpu.ops import optimizers
+
+
+class WideDeep(nn.Module):
+    deep_dim: int = 8
+    dnn_hidden_units: tuple = (16, 4)
+
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        linear_logits, field_embs, dense = CTREmbeddings(
+            deep_dim=self.deep_dim
+        )(features)
+        dnn_input = jnp.concatenate(
+            [dense, field_embs.reshape(field_embs.shape[0], -1)], axis=1
+        )
+        dnn_out = DNN(self.dnn_hidden_units)(dnn_input)
+        dnn_logit = nn.Dense(1, use_bias=False)(dnn_out)
+        return jnp.sum(
+            jnp.concatenate([linear_logits, dnn_logit], axis=1), axis=1
+        )
+
+
+def custom_model():
+    return WideDeep()
+
+
+loss = ctr_loss
+
+
+def optimizer(lr=0.001):
+    return optimizers.adam(learning_rate=lr)
+
+
+def eval_metrics_fn():
+    return ctr_metrics()

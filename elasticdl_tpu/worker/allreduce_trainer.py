@@ -176,6 +176,18 @@ class AllReduceTrainer(JaxTrainer):
                 self._variables = jax.device_put(variables, repl)
                 self._opt_state = jax.device_put(opt_state, repl)
                 self._version = version
+        elif self._variables is not None:
+            # Local device state was unreadable (poisoned by a failed
+            # collective) and nothing could be pulled from rank 0: drop it
+            # so init_variables_if_needed re-seeds from data instead of
+            # replaying poisoned buffers into every retry.
+            logger.warning(
+                "No recoverable state after world change; re-seeding "
+                "variables from data (version %d kept)", self._version,
+            )
+            with self._state_lock:
+                self._variables = None
+                self._opt_state = None
         self._group_id = resp.rendezvous_id
 
     def _pull_from_rank0(self, coordinator_addr):
