@@ -111,6 +111,14 @@ def add_ps_arguments(parser):
     parser.add_argument("--grads_to_wait", type=int, default=1)
     parser.add_argument("--sync_version_tolerance", type=int, default=0)
     parser.add_argument(
+        "--sync_window_timeout",
+        type=float,
+        default=30.0,
+        help="seconds before an unfilled sync quorum window applies what "
+        "it has (liveness under elastic shrink); raise for jobs whose "
+        "steps legitimately exceed it",
+    )
+    parser.add_argument(
         "--lr_staleness_modulation", action="store_true", default=False
     )
 

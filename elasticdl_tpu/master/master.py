@@ -88,6 +88,37 @@ class Master:
             seed=args.seed,
         )
 
+        if args.checkpoint_dir_for_init and training_shards:
+            # Restart-from-checkpoint: don't re-dispatch already-trained
+            # records (reference master.py:185-201 restores the completed
+            # step count from the checkpoint version).
+            from elasticdl_tpu.ps.checkpoint import (
+                latest_complete_version,
+                read_total_records,
+            )
+
+            version = latest_complete_version(args.checkpoint_dir_for_init)
+            if version:
+                # The checkpoint carries the exact number of training
+                # records consumed (version alone is ambiguous: a sync
+                # window merges a variable number of pushes, and tasks end
+                # in partial batches). Fall back to a version-based
+                # estimate for pre-field checkpoints.
+                records = read_total_records(
+                    args.checkpoint_dir_for_init, version
+                )
+                if not records:
+                    records = (
+                        version
+                        * (
+                            1
+                            if args.use_async
+                            else max(args.grads_to_wait, 1)
+                        )
+                        * args.minibatch_size
+                    )
+                self.task_d.set_completed_records(records)
+
         self.evaluation_service = None
         if evaluation_shards:
             self.evaluation_service = EvaluationService(
@@ -230,9 +261,13 @@ class Master:
                 "checkpoint_dir_for_init",
                 "grads_to_wait",
                 "sync_version_tolerance",
+                "sync_window_timeout",
             ):
                 value = getattr(self.args, flag, None)
-                if value:
+                # `is not None` so explicit numeric zeros (e.g.
+                # --sync_window_timeout 0) still relay; empty-string
+                # defaults for the path flags stay dropped.
+                if value is not None and value != "":
                     argv += [f"--{flag}", str(value)]
             if not self.args.use_async:
                 argv += ["--use_sync"]

@@ -122,6 +122,73 @@ class TaskDispatcher:
             self._todo.extend(tasks)
         return len(tasks)
 
+    def set_completed_records(self, records):
+        """Fast-forward past already-trained data on restart-from-checkpoint
+        (reference master.py:185-201 restores the completed-step count into
+        MaxStepsStopping so finished work is not re-dispatched). Whole
+        epochs are skipped exactly; the partial epoch is trimmed from the
+        front of the current (shuffled) task queue. Call before any worker
+        pulls a task."""
+        with self._lock:
+            if not self._training_shards or records <= 0 or self._doing:
+                return 0
+            epoch_records = sum(
+                n for _, n in self._training_shards.values()
+            )
+            full_epochs = min(records // epoch_records, self._num_epochs)
+            remainder = (
+                0
+                if full_epochs >= self._num_epochs
+                else records - full_epochs * epoch_records
+            )
+            if full_epochs >= self._num_epochs:
+                # Everything already trained: drain training work.
+                self._todo = collections.deque(
+                    t for t in self._todo if t.type != pb.TRAINING
+                )
+                self._epoch = self._num_epochs
+            elif full_epochs:
+                self._epoch = full_epochs + 1
+                # The queue currently holds epoch 1's permutation, but the
+                # interrupted run was consuming epoch full_epochs+1's — and
+                # each epoch rollover advanced the shared shuffle RNG once.
+                # Regenerate full_epochs times (discarding all but the
+                # last) so the trim below removes the records the original
+                # run actually trained.
+                self._todo = collections.deque(
+                    t for t in self._todo if t.type != pb.TRAINING
+                )
+                for i in range(full_epochs):
+                    n = self._create_tasks_locked(pb.TRAINING)
+                    if i < full_epochs - 1:
+                        for _ in range(n):
+                            self._todo.pop()
+            skipped = full_epochs * epoch_records
+            if remainder:
+                kept = collections.deque()
+                for task in self._todo:
+                    if task.type != pb.TRAINING or remainder <= 0:
+                        kept.append(task)
+                        continue
+                    size = task.end - task.start
+                    if remainder >= size:
+                        remainder -= size
+                        skipped += size
+                    else:
+                        task.start += remainder
+                        skipped += remainder
+                        remainder = 0
+                        kept.append(task)
+                self._todo = kept
+            if skipped:
+                logger.info(
+                    "Resume: skipping %d already-trained records "
+                    "(%d full epochs)",
+                    skipped,
+                    full_epochs,
+                )
+            return skipped
+
     def create_evaluation_tasks(self, model_version):
         """Version-triggered eval: tasks go to the FRONT of the queue so
         training workers pick them up promptly."""

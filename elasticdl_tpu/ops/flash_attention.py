@@ -439,8 +439,11 @@ def flash_attention(
 ):
     """Attention over [B, H, S, D]; S must be a multiple of the (clamped)
     block sizes on the Pallas path (the reference path has no constraint)."""
-    out, _ = _fwd(q, k, v, causal, block_q, block_k)
-    return out
+    bq, bk = _clamp_blocks(q.shape[2], block_q, block_k)
+    if _pallas_ok(q.shape[2], bq, bk):
+        out, _ = _flash_forward(q, k, v, causal, bq, bk, emit_lse=False)
+        return out
+    return reference_attention(q, k, v, causal)
 
 
 def _clamp_blocks(s, block_q, block_k):
@@ -454,7 +457,7 @@ def _pallas_ok(s, block_q, block_k):
 def _fwd(q, k, v, causal, block_q, block_k):
     bq, bk = _clamp_blocks(q.shape[2], block_q, block_k)
     if _pallas_ok(q.shape[2], bq, bk):
-        out, lse = _flash_forward(q, k, v, causal, bq, bk)
+        out, lse = _flash_forward(q, k, v, causal, bq, bk, emit_lse=True)
         return out, (q, k, v, out, lse)
     out = reference_attention(q, k, v, causal)
     return out, (q, k, v, out, None)

@@ -9,6 +9,7 @@ checkpoint is valid iff the complete shard set is present; restore reshards
 with a different PS count; keep_checkpoint_max GC prunes old versions.
 """
 
+import json
 import os
 import re
 import shutil
@@ -63,6 +64,15 @@ class CheckpointSaver:
         with open(tmp, "wb") as f:
             f.write(model.SerializeToString())
         os.replace(tmp, path)
+        # Tiny sidecar so a resuming master can read the consumed-record
+        # count without deserializing the (possibly multi-GB) shard pb.
+        meta = _meta_path(self._dir, version, self._ps_id, self._num_ps)
+        with open(f"{meta}.tmp", "w") as f:
+            json.dump(
+                {"version": version, "total_records": model.total_records},
+                f,
+            )
+        os.replace(f"{meta}.tmp", meta)
         logger.info("Saved checkpoint shard %s", path)
         self._gc()
 
@@ -110,6 +120,39 @@ def latest_complete_version(checkpoint_dir):
     return None
 
 
+def _meta_path(checkpoint_dir, version, ps_id, num_ps):
+    return os.path.join(
+        _version_dir(checkpoint_dir, version),
+        f"meta-{ps_id}-of-{num_ps}.json",
+    )
+
+
+def read_total_records(checkpoint_dir, version):
+    """Max total_records across a checkpoint's shards — the exact count of
+    training records consumed when it was written (each push fans out to
+    every shard holding one of its params, so the busiest shard's counter
+    is the job-wide number). Prefers the tiny meta sidecars; falls back to
+    parsing shard protobufs (pre-sidecar checkpoints). 0 when absent."""
+    vdir = _version_dir(checkpoint_dir, version)
+    total = 0
+    found_meta = False
+    for entry in sorted(os.listdir(vdir)):
+        if entry.startswith("meta-") and entry.endswith(".json"):
+            with open(os.path.join(vdir, entry)) as f:
+                total = max(total, json.load(f).get("total_records", 0))
+            found_meta = True
+    if found_meta:
+        return total
+    for entry in sorted(os.listdir(vdir)):
+        if not _SHARD_RE.fullmatch(entry):
+            continue
+        model = pb.Model()
+        with open(os.path.join(vdir, entry), "rb") as f:
+            model.ParseFromString(f.read())
+        total = max(total, model.total_records)
+    return total
+
+
 def restore_shard(checkpoint_dir, version, parameters, ps_id, num_ps):
     """Load `parameters` for PS shard `ps_id` of `num_ps` from a checkpoint
     written by ANY shard count: reads every saved shard file and keeps what
@@ -126,6 +169,9 @@ def restore_shard(checkpoint_dir, version, parameters, ps_id, num_ps):
             with open(os.path.join(vdir, entry), "rb") as f:
                 model.ParseFromString(f.read())
             parameters.init_embedding_infos(model.embedding_table_infos)
+            parameters.total_records = max(
+                parameters.total_records, model.total_records
+            )
             for t in model.dense_parameters:
                 if hash_utils.string_to_id(t.name, num_ps) != ps_id:
                     continue

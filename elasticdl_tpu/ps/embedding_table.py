@@ -19,9 +19,9 @@ import threading
 import numpy as np
 
 from elasticdl_tpu import native
+from elasticdl_tpu.ps.initializers import make_row_initializer
 
 DEFAULT_CAPACITY = 1024
-INIT_LOW, INIT_HIGH = -0.05, 0.05
 
 
 class EmbeddingTable:
@@ -31,6 +31,14 @@ class EmbeddingTable:
         self.dim = int(dim)
         self.initializer = initializer
         self.dtype = np.dtype(dtype)
+        # Full initializer library (zeros/constant/uniform/normal/
+        # truncated_normal, optionally parameterized — ps/initializers.py,
+        # matching the reference's initializer.go). Uniform specs resolve
+        # to a (low, high) range that _init_row feeds the fast native
+        # kernel; everything else goes through the numpy closure.
+        self._init_fn, self._uniform_range = make_row_initializer(
+            initializer, self.dim, self.dtype
+        )
         self._lock = threading.RLock()
         self._slab = np.zeros((capacity, self.dim), dtype=self.dtype)
         self._id_to_row = {}
@@ -67,24 +75,23 @@ class EmbeddingTable:
 
     def _init_row(self, row):
         dst = self._slab[row]
-        if self.initializer == "zeros":
-            dst[:] = 0.0
-            return
         # Deterministic per-row seed so a resharded restore that re-inits
         # unseen ids stays reproducible.
-        lib = native.lib()
         seed = (self._seed * 0x9E3779B1 + row + 1) & 0xFFFFFFFFFFFFFFFF
-        if lib is not None and self.dtype == np.float32:
+        lib = native.lib()
+        if (
+            self._uniform_range is not None
+            and lib is not None
+            and self.dtype == np.float32
+        ):
+            low, high = self._uniform_range
             lib.edl_uniform_init(
                 dst.ctypes.data_as(native.ctypes.POINTER(
                     native.ctypes.c_float)),
-                self.dim, INIT_LOW, INIT_HIGH, seed,
+                self.dim, low, high, seed,
             )
         else:
-            rng = np.random.default_rng(seed)
-            dst[:] = rng.uniform(INIT_LOW, INIT_HIGH, self.dim).astype(
-                self.dtype
-            )
+            self._init_fn(dst, seed)
 
     def rows_for_ids(self, ids, create_missing=True):
         """id array -> row-index array, lazily materializing unseen ids (the

@@ -1,0 +1,88 @@
+"""Row-initializer library for PS-resident embedding tables.
+
+Reference counterpart: /root/reference/elasticdl/go/pkg/common/
+initializer.go (Zero/Constant/RandomUniform/RandomNorm/TruncatedNormal).
+Initializers are named by a spec string carried in EmbeddingTableInfo —
+either a bare name ("uniform", "normal", "truncated_normal", "zeros") or a
+parameterized form ("uniform(-0.05,0.05)", "normal(0,0.01)",
+"constant(0.3)"). Each call fills one row deterministically from a per-row
+seed so a resharded restore that re-initializes unseen ids stays
+reproducible across PS replacements.
+"""
+
+import re
+
+import numpy as np
+
+_SPEC_RE = re.compile(r"^\s*([a-zA-Z_]+)\s*(?:\(([^)]*)\))?\s*$")
+
+DEFAULT_UNIFORM_LOW, DEFAULT_UNIFORM_HIGH = -0.05, 0.05
+DEFAULT_NORMAL_MEAN, DEFAULT_NORMAL_STD = 0.0, 0.05
+
+
+def parse_initializer_spec(spec):
+    """'name' or 'name(a,b,...)' -> (name, [float args])."""
+    m = _SPEC_RE.match(spec or "uniform")
+    if not m:
+        raise ValueError(f"bad initializer spec {spec!r}")
+    name = m.group(1).lower()
+    args = []
+    if m.group(2):
+        args = [float(a) for a in m.group(2).split(",") if a.strip()]
+    return name, args
+
+
+def _truncated_normal(rng, mean, std, n):
+    """Resample values outside mean +/- 2*std (the usual truncation rule the
+    reference's TruncatedNormal implements via rejection)."""
+    out = rng.normal(mean, std, n)
+    bad = np.abs(out - mean) > 2.0 * std
+    while bad.any():
+        out[bad] = rng.normal(mean, std, int(bad.sum()))
+        bad = np.abs(out - mean) > 2.0 * std
+    return out
+
+
+def make_row_initializer(spec, dim, dtype=np.float32):
+    """spec string -> fn(dst_row, seed) filling one [dim] row in place.
+
+    Returns (fn, uniform_range): uniform_range is the resolved (low, high)
+    for uniform specs — the single source of truth the caller may hand to
+    the native C uniform kernel instead of calling fn — and None otherwise.
+    """
+    name, args = parse_initializer_spec(spec)
+    if name in ("zero", "zeros"):
+        def init(dst, seed):
+            dst[:] = 0.0
+        return init, None
+    if name == "constant":
+        value = args[0] if args else 0.0
+
+        def init(dst, seed):
+            dst[:] = value
+        return init, None
+    if name == "uniform" or name == "random_uniform":
+        low = args[0] if args else DEFAULT_UNIFORM_LOW
+        high = args[1] if len(args) > 1 else DEFAULT_UNIFORM_HIGH
+
+        def init(dst, seed):
+            rng = np.random.default_rng(seed)
+            dst[:] = rng.uniform(low, high, dim).astype(dtype)
+        return init, (low, high)
+    if name in ("normal", "random_normal"):
+        mean = args[0] if args else DEFAULT_NORMAL_MEAN
+        std = args[1] if len(args) > 1 else DEFAULT_NORMAL_STD
+
+        def init(dst, seed):
+            rng = np.random.default_rng(seed)
+            dst[:] = rng.normal(mean, std, dim).astype(dtype)
+        return init, None
+    if name == "truncated_normal":
+        mean = args[0] if args else DEFAULT_NORMAL_MEAN
+        std = args[1] if len(args) > 1 else DEFAULT_NORMAL_STD
+
+        def init(dst, seed):
+            rng = np.random.default_rng(seed)
+            dst[:] = _truncated_normal(rng, mean, std, dim).astype(dtype)
+        return init, None
+    raise ValueError(f"unknown initializer {name!r} (spec {spec!r})")

@@ -37,6 +37,7 @@ def main(argv=None):
         use_async=args.use_async,
         grads_to_wait=args.grads_to_wait,
         sync_version_tolerance=args.sync_version_tolerance,
+        sync_window_timeout=args.sync_window_timeout,
         lr_staleness_modulation=args.lr_staleness_modulation,
         checkpoint_dir=args.checkpoint_dir or None,
         checkpoint_steps=args.checkpoint_steps,

@@ -31,6 +31,7 @@ class ParameterServer:
         use_async=True,
         grads_to_wait=1,
         sync_version_tolerance=0,
+        sync_window_timeout=30.0,
         lr_staleness_modulation=False,
         checkpoint_dir=None,
         checkpoint_steps=0,
@@ -68,6 +69,7 @@ class ParameterServer:
             use_async=use_async,
             grads_to_wait=grads_to_wait,
             sync_version_tolerance=sync_version_tolerance,
+            sync_window_timeout=sync_window_timeout,
             lr_staleness_modulation=lr_staleness_modulation,
             checkpoint_saver=saver,
             checkpoint_steps=checkpoint_steps,

@@ -24,6 +24,9 @@ class Parameters:
         self.dense = {}  # name -> np.ndarray (float32, contiguous)
         self.embedding_tables = {}  # name -> EmbeddingTable
         self.version = 0
+        # Training records behind accepted gradient pushes so far;
+        # checkpointed for exact resume fast-forwarding.
+        self.total_records = 0
         self.initialized = False
         self.init_lock = threading.Lock()
 
@@ -58,7 +61,10 @@ class Parameters:
                 )
 
     def to_model_pb(self, include_embeddings=True):
-        model = pb.Model(version=self.version)
+        model = pb.Model(
+            version=self.version,
+            total_records=self.total_records,
+        )
         for name in sorted(self.dense):
             model.dense_parameters.append(
                 tensor_utils.ndarray_to_tensor_pb(self.dense[name], name)

@@ -17,6 +17,7 @@ servicer.py:33-288). Semantics kept:
 """
 
 import threading
+import time
 
 import numpy as np
 
@@ -39,6 +40,7 @@ class PserverServicer:
         use_async=True,
         grads_to_wait=1,
         sync_version_tolerance=0,
+        sync_window_timeout=30.0,
         lr_staleness_modulation=False,
         checkpoint_saver=None,
         checkpoint_steps=0,
@@ -60,6 +62,20 @@ class PserverServicer:
         self._grad_sum = {}  # dense name -> np array
         self._grad_n = 0
         self._sparse_acc = {}  # table name -> ([values...], [ids...])
+        # Quorum counts DISTINCT workers, not raw pushes: one fast worker
+        # pushing twice in a window must not satisfy grads_to_wait alone
+        # (its second push still contributes to the average). Anonymous
+        # pushes (worker_id_plus_one == 0) each count as a fresh worker,
+        # matching the reference's coarse push counter
+        # (python/ps/servicer.py:166-236). Liveness escape hatch: if the
+        # quorum hasn't filled within sync_window_timeout of the window's
+        # first push (survivors of an elastic shrink keep re-pushing), the
+        # next push applies whatever has accumulated rather than hanging
+        # the job forever.
+        self._sync_window_timeout = sync_window_timeout
+        self._push_workers = set()
+        self._anon_pushes = 0
+        self._window_start = None
 
     # ---------- rpc methods (names match rpc.PSERVER_SERVICE) ----------
 
@@ -128,6 +144,7 @@ class PserverServicer:
         # go/pkg/ps/server.go:67-68,176-206).
         with self._version_lock:
             self._apply_model_pb(request.gradients)
+            self._params.total_records += request.batch_size
             self._params.version += 1
             version = self._params.version
             snapshot = self._snapshot_if_due(version)
@@ -159,9 +176,27 @@ class PserverServicer:
                 acc[0].append(values)
                 acc[1].append(ids)
             self._grad_n += 1
-            if self._grad_n < self._grads_to_wait:
+            self._params.total_records += request.batch_size
+            if self._window_start is None:
+                self._window_start = time.monotonic()
+            if request.worker_id_plus_one > 0:
+                self._push_workers.add(request.worker_id_plus_one - 1)
+            else:
+                self._anon_pushes += 1
+            quorum = len(self._push_workers) + self._anon_pushes
+            window_expired = (
+                time.monotonic() - self._window_start
+                > self._sync_window_timeout
+            )
+            if quorum < self._grads_to_wait and not window_expired:
                 return pb.PushGradientsResponse(
                     accepted=True, version=self._params.version
+                )
+            if window_expired and quorum < self._grads_to_wait:
+                logger.warning(
+                    "Sync window timed out with %d/%d workers; applying "
+                    "%d buffered pushes",
+                    quorum, self._grads_to_wait, self._grad_n,
                 )
             # Quorum reached: average dense, merge sparse, apply once.
             self._opt.begin_apply()
@@ -183,6 +218,9 @@ class PserverServicer:
             self._grad_sum.clear()
             self._sparse_acc.clear()
             self._grad_n = 0
+            self._push_workers.clear()
+            self._anon_pushes = 0
+            self._window_start = None
             self._params.version += 1
             version = self._params.version
             snapshot = self._snapshot_if_due(version)

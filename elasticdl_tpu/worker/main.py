@@ -35,7 +35,7 @@ def build_trainer(args, spec, master_client):
             model,
             spec.loss,
             optimizer_spec,
-            PSClient(args.ps_addrs.split(",")),
+            PSClient(args.ps_addrs.split(","), worker_id=args.worker_id),
             embedding_inputs=getattr(spec.module, "embedding_inputs", None),
             seed=args.seed,
         )

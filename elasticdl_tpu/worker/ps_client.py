@@ -15,9 +15,10 @@ from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
 
 class PSClient:
-    def __init__(self, ps_addrs):
+    def __init__(self, ps_addrs, worker_id=-1):
         """ps_addrs: list of "host:port", index = ps_id."""
         self._addrs = list(ps_addrs)
+        self._worker_id = worker_id
         self._channels = [rpc.build_channel(a) for a in self._addrs]
         self._stubs = [
             rpc.Stub(ch, rpc.PSERVER_SERVICE) for ch in self._channels
@@ -147,11 +148,14 @@ class PSClient:
     # ---------- gradient push ----------
 
     def push_gradients(
-        self, dense_grads, sparse_grads, version, learning_rate=0.0
+        self, dense_grads, sparse_grads, version, learning_rate=0.0,
+        batch_size=0,
     ):
         """dense_grads: {name: ndarray}; sparse_grads:
         {table_name: (values [k, dim], ids [k])} — deduplicated here before
-        partitioning. Returns (accepted_all, max_version)."""
+        partitioning. batch_size = records in the minibatch behind this
+        push (feeds the checkpoint's exact consumed-record counter).
+        Returns (accepted_all, max_version)."""
         dense_parts = self.partition_dense_names(dense_grads)
         shard_models = {}
 
@@ -190,7 +194,12 @@ class PSClient:
         futures = [
             self._stubs[ps_id].push_gradients.future(
                 pb.PushGradientsRequest(
-                    gradients=m, learning_rate=learning_rate
+                    gradients=m,
+                    learning_rate=learning_rate,
+                    worker_id_plus_one=(
+                        self._worker_id + 1 if self._worker_id >= 0 else 0
+                    ),
+                    batch_size=batch_size,
                 )
             )
             for ps_id, m in shard_models.items()

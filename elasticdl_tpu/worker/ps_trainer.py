@@ -226,7 +226,10 @@ class ParameterServerTrainer(JaxTrainer):
                     flat_ids[table],
                 )
             accepted, version = self._ps.push_gradients(
-                dense_named, sparse, version=self._version
+                dense_named,
+                sparse,
+                version=self._version,
+                batch_size=int(np.asarray(labels).shape[0]),
             )
             self._version = max(self._version, version)
             if accepted:

@@ -292,3 +292,122 @@ def test_staleness_lr_modulation():
         client.close()
     finally:
         server.stop()
+
+
+def test_sync_quorum_counts_distinct_workers():
+    """grads_to_wait=2 means two DISTINCT workers: one fast worker pushing
+    twice must not satisfy the quorum alone (its pushes still average in)."""
+    server = ParameterServer(
+        0,
+        1,
+        optimizer_spec=optimizers.sgd(1.0),
+        use_async=False,
+        grads_to_wait=2,
+        sync_version_tolerance=1,
+    )
+    try:
+        fast = PSClient([server.addr], worker_id=7)
+        slow = PSClient([server.addr], worker_id=8)
+        fast.push_model({"w": np.zeros(2, np.float32)}, [])
+        g = {"w": np.array([3.0, 3.0], np.float32)}
+        # Same worker twice: buffered, never applied.
+        for _ in range(2):
+            accepted, version = fast.push_gradients(g, {}, version=0)
+            assert accepted and version == 0
+        _, _, params = fast.pull_dense_parameters(["w"], version=0)
+        np.testing.assert_array_equal(params["w"], [0.0, 0.0])
+        # A second distinct worker completes the quorum; all three pushes
+        # average: (3+3+3)/3 = 3 -> w = -3 with lr 1.
+        accepted, version = slow.push_gradients(g, {}, version=0)
+        assert accepted and version == 1
+        _, _, params = slow.pull_dense_parameters(["w"], version=0)
+        np.testing.assert_allclose(params["w"], [-3.0, -3.0])
+        fast.close()
+        slow.close()
+    finally:
+        server.stop()
+
+
+def test_initializer_library():
+    from elasticdl_tpu.ps.initializers import (
+        make_row_initializer,
+        parse_initializer_spec,
+    )
+
+    assert parse_initializer_spec("uniform") == ("uniform", [])
+    assert parse_initializer_spec("normal(0.5, 0.1)") == (
+        "normal",
+        [0.5, 0.1],
+    )
+    dim = 4096
+    row = np.empty(dim, np.float32)
+
+    fn, plain = make_row_initializer("uniform", dim)
+    assert plain
+    fn(row, seed=1)
+    assert row.min() >= -0.05 and row.max() <= 0.05
+
+    fn, _ = make_row_initializer("constant(0.3)", dim)
+    fn(row, seed=1)
+    np.testing.assert_allclose(row, 0.3)
+
+    fn, _ = make_row_initializer("zeros", dim)
+    fn(row, seed=1)
+    np.testing.assert_allclose(row, 0.0)
+
+    fn, _ = make_row_initializer("normal(1.0,0.01)", dim)
+    fn(row, seed=1)
+    assert abs(row.mean() - 1.0) < 0.01 and 0.005 < row.std() < 0.02
+
+    fn, _ = make_row_initializer("truncated_normal(0,1)", dim)
+    fn(row, seed=1)
+    assert np.abs(row).max() <= 2.0
+    # Determinism: same seed, same row.
+    row2 = np.empty(dim, np.float32)
+    fn(row2, seed=1)
+    np.testing.assert_array_equal(row, row2)
+
+    with pytest.raises(ValueError):
+        make_row_initializer("bogus", dim)
+
+
+def test_embedding_table_parameterized_initializer():
+    t = EmbeddingTable("e", 8, initializer="constant(0.25)")
+    rows = t.lookup(np.array([5, 9], np.int64))
+    np.testing.assert_allclose(rows, 0.25)
+    t2 = EmbeddingTable("n", 64, initializer="normal(0,0.02)")
+    rows = t2.lookup(np.arange(128, dtype=np.int64))
+    assert abs(float(rows.mean())) < 0.01
+
+
+def test_sync_window_timeout_preserves_liveness():
+    """If the distinct-worker quorum can't fill (a worker died and was not
+    relaunched), the sync window times out and applies what it has instead
+    of hanging the job forever."""
+    server = ParameterServer(
+        0,
+        1,
+        optimizer_spec=optimizers.sgd(1.0),
+        use_async=False,
+        grads_to_wait=2,
+        sync_version_tolerance=1,
+        sync_window_timeout=0.3,
+    )
+    try:
+        lone = PSClient([server.addr], worker_id=7)
+        lone.push_model({"w": np.zeros(1, np.float32)}, [])
+        g = {"w": np.array([2.0], np.float32)}
+        # First push opens the window: buffered, no apply.
+        accepted, version = lone.push_gradients(g, {}, version=0)
+        assert accepted and version == 0
+        # Second push from the SAME worker after the window expires:
+        # quorum is still 1/2 but both pushes average and apply.
+        import time as _time
+        _time.sleep(0.4)
+        accepted, version = lone.push_gradients(g, {}, version=0)
+        assert accepted and version == 1
+        _, _, params = lone.pull_dense_parameters(["w"], version=0)
+        np.testing.assert_allclose(params["w"], [-2.0])
+        lone.close()
+    finally:
+        server.stop()

@@ -132,3 +132,82 @@ def test_train_end_callback_task():
     assert not task_d.finished()
     task_d.report(tid, True)
     assert task_d.finished()
+
+
+def test_set_completed_records_partial_epoch():
+    """Resume mid-epoch: leading records are trimmed from the task queue."""
+    task_d = make_dispatcher(
+        training_shards={"f": (0, 100)}, records_per_task=30
+    )
+    skipped = task_d.set_completed_records(45)
+    assert skipped == 45
+    tasks = [t for _, t in drain(task_d)]
+    # 100 - 45 = 55 records remain: [45,60) (trimmed), [60,90), [90,100).
+    assert sum(t.end - t.start for t in tasks) == 55
+    assert tasks[0].start == 45
+
+
+def test_set_completed_records_whole_epochs():
+    task_d = make_dispatcher(
+        training_shards={"f": (0, 100)},
+        records_per_task=50,
+        num_epochs=3,
+    )
+    # 2 full epochs + 30 records trained already.
+    skipped = task_d.set_completed_records(230)
+    assert skipped == 230
+    tasks = drain(task_d)
+    assert sum(t.end - t.start for _, t in tasks) == 70
+    for tid, _ in tasks:
+        task_d.report(tid, True)
+    assert task_d.finished()
+
+
+def test_set_completed_records_everything_trained():
+    task_d = make_dispatcher(
+        training_shards={"f": (0, 100)}, records_per_task=50, num_epochs=2
+    )
+    task_d.set_completed_records(1000)
+    assert drain(task_d) == []
+    assert task_d.finished()
+
+
+def test_set_completed_records_shuffled_resume_exact():
+    """With shuffling, resume must trim the records the ORIGINAL run
+    actually trained (the RNG advances one shuffle per epoch): the prefix
+    consumed before the crash plus everything the resumed dispatcher
+    serves must cover each record exactly num_epochs times."""
+
+    def records_of(task):
+        return [(task.shard_name, r) for r in range(task.start, task.end)]
+
+    kwargs = dict(
+        training_shards={"f": (0, 90)},
+        records_per_task=20,
+        num_epochs=3,
+        shuffle=True,
+        seed=123,
+    )
+    # Original run: consume 130 records (1 full epoch + 40 into epoch 2).
+    original = TaskDispatcher(**kwargs)
+    consumed = []
+    while len(consumed) < 130:
+        tid, task = original.get(0)
+        recs = records_of(task)
+        take = min(len(recs), 130 - len(consumed))
+        consumed.extend(recs[:take])
+        original.report(tid, True)
+    assert len(consumed) == 130
+
+    # Crash + resume from 130 completed records.
+    resumed = TaskDispatcher(**kwargs)
+    resumed.set_completed_records(130)
+    remaining = []
+    for _, task in drain(resumed):
+        remaining.extend(records_of(task))
+
+    import collections as c
+
+    counts = c.Counter(consumed) + c.Counter(remaining)
+    assert set(counts.values()) == {3}
+    assert len(counts) == 90
