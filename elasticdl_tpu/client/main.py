@@ -224,6 +224,74 @@ def _zoo_build(args):
     return 0
 
 
+def _top(args):
+    """Live job monitor: poll the master's job-status RPC and print one
+    status line per interval (the in-job analog of the reference's
+    pod-polling job monitor, k8s_job_monitor.py:94-207; throughput is
+    derived by diffing records_done between polls)."""
+    import time
+
+    from elasticdl_tpu.common import rpc
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    import grpc
+
+    stub = rpc.Stub(
+        rpc.build_channel(args.master_addr), rpc.MASTER_SERVICE
+    )
+    prev_records, prev_ts = None, None
+    last_status = None
+    errors = 0
+    for _ in range(args.iterations) if args.iterations else iter(int, 1):
+        try:
+            status = stub.get_job_status(pb.GetJobStatusRequest())
+        except grpc.RpcError as e:
+            # The master stops its server as soon as the job ends, so an
+            # UNAVAILABLE between polls usually means "job over", not an
+            # error. Retry a few times, then report what we last saw.
+            errors += 1
+            if errors < 3:
+                time.sleep(args.interval)
+                continue
+            if last_status is not None and last_status.finished:
+                return 1 if last_status.job_failed else 0
+            print(
+                f"master {args.master_addr} unreachable "
+                f"({e.code().name}); job likely ended",
+                flush=True,
+            )
+            return 2
+        errors = 0
+        last_status = status
+        now = time.time()
+        rate = ""
+        if prev_records is not None and now > prev_ts:
+            rps = (status.records_done - prev_records) / (now - prev_ts)
+            rate = f" {rps:8.1f} rec/s"
+        prev_records, prev_ts = status.records_done, now
+        evals = ""
+        if status.last_eval_metrics:
+            shown = ", ".join(
+                f"{k}={v:.4f}"
+                for k, v in sorted(status.last_eval_metrics.items())
+            )
+            evals = f" eval@v{status.last_eval_version}[{shown}]"
+        print(
+            f"epoch {status.epoch}/{status.num_epochs} "
+            f"v{status.model_version} "
+            f"tasks todo={status.todo_tasks} doing={status.doing_tasks} "
+            f"workers={status.alive_workers} "
+            f"records={status.records_done}{rate}{evals}"
+            + (" FAILED" if status.job_failed else "")
+            + (" FINISHED" if status.finished else ""),
+            flush=True,
+        )
+        if status.finished or status.job_failed:
+            return 1 if status.job_failed else 0
+        time.sleep(args.interval)
+    return 0
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     top = argparse.ArgumentParser(
@@ -231,9 +299,21 @@ def main(argv=None):
     )
     top.add_argument(
         "command",
-        choices=["train", "evaluate", "predict", "zoo"],
+        choices=["train", "evaluate", "predict", "zoo", "top"],
     )
     ns, rest = top.parse_known_args(argv)
+
+    if ns.command == "top":
+        monitor = argparse.ArgumentParser("edl top")
+        monitor.add_argument("--master_addr", required=True)
+        monitor.add_argument("--interval", type=float, default=5.0)
+        monitor.add_argument(
+            "--iterations",
+            type=int,
+            default=0,
+            help="stop after N polls (0 = until the job ends)",
+        )
+        return _top(monitor.parse_args(rest))
 
     if ns.command == "zoo":
         zoo = argparse.ArgumentParser("edl zoo")

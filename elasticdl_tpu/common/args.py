@@ -70,6 +70,12 @@ def add_train_arguments(parser):
         help="restore PS state from this checkpoint dir at boot",
     )
     parser.add_argument("--output", default="", help="model export path")
+    parser.add_argument(
+        "--metrics_dir",
+        default="",
+        help="publish eval/throughput scalars here as metrics.jsonl + "
+        "TensorBoard event files (point tensorboard --logdir at it)",
+    )
 
 
 def add_cluster_arguments(parser):

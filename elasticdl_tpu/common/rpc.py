@@ -40,6 +40,7 @@ MASTER_SERVICE = ServiceSpec(
         "report_version": (pb.ReportVersionRequest, pb.Empty),
         "get_comm_rank": (pb.GetCommRankRequest, pb.GetCommRankResponse),
         "report_worker_liveness": (pb.ReportWorkerLivenessRequest, pb.Empty),
+        "get_job_status": (pb.GetJobStatusRequest, pb.JobStatusResponse),
     },
 )
 

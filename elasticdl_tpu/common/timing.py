@@ -1,0 +1,78 @@
+"""Phase timing accumulators for the worker/PS hot paths.
+
+Reference counterpart: /root/reference/elasticdl/python/common/
+timing_utils.py:17-48 (named start/end wall-clock accumulators reported at
+task granularity under DEBUG) — redesigned as a context-manager API so a
+phase can't be left open, plus per-phase call counts and means, which is
+what a step-time breakdown (pull / step / push, the reference's published
+benchmark decomposition, docs/benchmark/ftlib_benchmark.md:119-124) needs.
+"""
+
+import contextlib
+import threading
+import time
+
+
+class Timing:
+    """Accumulates wall-clock per named phase. Thread-safe; one instance is
+    typically owned by a trainer and reported per task or per N steps."""
+
+    def __init__(self, enabled=True):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._total = {}
+        self._count = {}
+
+    @contextlib.contextmanager
+    def record(self, phase):
+        if not self.enabled:
+            yield
+            return
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - start
+            with self._lock:
+                self._total[phase] = self._total.get(phase, 0.0) + elapsed
+                self._count[phase] = self._count.get(phase, 0) + 1
+
+    def add(self, phase, seconds):
+        """Fold in an externally-measured duration (e.g. from a jitted
+        step whose completion is observed asynchronously)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._total[phase] = self._total.get(phase, 0.0) + seconds
+            self._count[phase] = self._count.get(phase, 0) + 1
+
+    def summary(self):
+        """{phase: {"total_s", "count", "mean_s"}}"""
+        with self._lock:
+            return {
+                phase: {
+                    "total_s": total,
+                    "count": self._count[phase],
+                    "mean_s": total / max(self._count[phase], 1),
+                }
+                for phase, total in self._total.items()
+            }
+
+    def reset(self):
+        with self._lock:
+            self._total.clear()
+            self._count.clear()
+
+    def report(self, logger, reset=False):
+        """DEBUG-log the per-phase breakdown (the reference's
+        report_timing shape)."""
+        for phase, s in sorted(self.summary().items()):
+            logger.debug(
+                "%s: %.6gs total / %d calls / %.6gs mean",
+                phase,
+                s["total_s"],
+                s["count"],
+                s["mean_s"],
+            )
+        if reset:
+            self.reset()

@@ -119,6 +119,12 @@ class Master:
                     )
                 self.task_d.set_completed_records(records)
 
+        self.metrics_service = None
+        if getattr(args, "metrics_dir", ""):
+            from elasticdl_tpu.master.metrics_service import MetricsService
+
+            self.metrics_service = MetricsService(args.metrics_dir)
+
         self.evaluation_service = None
         if evaluation_shards:
             self.evaluation_service = EvaluationService(
@@ -127,6 +133,11 @@ class Master:
                 if self.spec.eval_metrics_fn
                 else dict,
                 eval_steps=args.evaluation_steps,
+                on_results=(
+                    self.metrics_service.on_evaluation_results
+                    if self.metrics_service
+                    else None
+                ),
             )
 
         self.membership = (
@@ -139,7 +150,10 @@ class Master:
             # train-end callback task, master/callbacks.py:38-66).
             self.task_d.enable_train_end_task()
         self.servicer = MasterServicer(
-            self.task_d, self.evaluation_service, self.membership
+            self.task_d,
+            self.evaluation_service,
+            self.membership,
+            worker_liveness_timeout=args.worker_liveness_timeout_seconds,
         )
         self._server = None
         self.port = None
@@ -295,6 +309,8 @@ class Master:
             5.0, self.args.task_timeout_check_seconds
         )
         last_watchdog = time.time()
+        last_metrics = time.time()
+        last_records = self.task_d.stats()["records_done"]
         try:
             while True:
                 if self.task_d.finished():
@@ -323,6 +339,24 @@ class Master:
                 ):
                     last_watchdog = now
                     self._run_watchdog()
+                if self.metrics_service and now - last_metrics >= 30.0:
+                    stats = self.task_d.stats()
+                    elapsed = now - last_metrics
+                    self.metrics_service.log_scalars(
+                        "train",
+                        self.servicer.max_model_version,
+                        {
+                            "records_per_sec": (
+                                stats["records_done"] - last_records
+                            ) / elapsed,
+                            "records_done": stats["records_done"],
+                            "epoch": stats["epoch"],
+                            "todo_tasks": stats["todo"],
+                            "doing_tasks": stats["doing"],
+                        },
+                    )
+                    last_metrics = now
+                    last_records = stats["records_done"]
                 time.sleep(poll)
         finally:
             self.stop()
@@ -355,5 +389,20 @@ class Master:
     def stop(self):
         if self.instance_manager is not None:
             self.instance_manager.stop()
+        if self.metrics_service is not None:
+            # Final snapshot so short jobs (ending inside the periodic
+            # interval) still leave a record.
+            stats = self.task_d.stats()
+            self.metrics_service.log_scalars(
+                "train",
+                self.servicer.max_model_version,
+                {
+                    "records_done": stats["records_done"],
+                    "epoch": stats["epoch"],
+                    "todo_tasks": stats["todo"],
+                    "doing_tasks": stats["doing"],
+                },
+            )
+            self.metrics_service.close()
         if self._server is not None:
             self._server.stop(2)

@@ -1,0 +1,72 @@
+"""Master-side metrics publishing: structured JSONL + TensorBoard events.
+
+Reference counterpart: /root/reference/elasticdl/python/master/
+tensorboard_service.py:21-62 (a tf.summary writer fed by the evaluation
+service) — redesigned around a framework-neutral JSONL stream as the source
+of truth (greppable, no reader dependency) with TensorBoard event files
+written alongside when a SummaryWriter implementation is importable
+(torch.utils.tensorboard in this image). The reference's k8s LoadBalancer
+exposure (common/k8s_tensorboard_client.py:22-66) is subsumed by pointing
+`tensorboard --logdir` at the job's metrics directory.
+"""
+
+import json
+import os
+import threading
+import time
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("master.metrics_service")
+
+
+def _make_summary_writer(log_dir):
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+
+        return SummaryWriter(log_dir=log_dir)
+    except Exception:
+        logger.info(
+            "No TensorBoard SummaryWriter available; writing JSONL only"
+        )
+        return None
+
+
+class MetricsService:
+    """Append-only scalar metrics sink.
+
+    Layout under `metrics_dir`:
+      metrics.jsonl             one {"ts", "group", "step", <name>: value}
+                                object per line
+      events.out.tfevents.*     TensorBoard scalars (tag "<group>/<name>"),
+                                when a writer is available
+    """
+
+    def __init__(self, metrics_dir, tensorboard=True):
+        self._dir = metrics_dir
+        os.makedirs(metrics_dir, exist_ok=True)
+        self._path = os.path.join(metrics_dir, "metrics.jsonl")
+        self._lock = threading.Lock()
+        self._tb = _make_summary_writer(metrics_dir) if tensorboard else None
+
+    def log_scalars(self, group, step, scalars):
+        """scalars: {name: number}; step: model version / global step."""
+        clean = {k: float(v) for k, v in scalars.items()}
+        line = json.dumps(
+            {"ts": time.time(), "group": group, "step": int(step), **clean}
+        )
+        with self._lock:
+            with open(self._path, "a") as f:
+                f.write(line + "\n")
+            if self._tb is not None:
+                for name, value in clean.items():
+                    self._tb.add_scalar(f"{group}/{name}", value, int(step))
+                self._tb.flush()
+
+    def on_evaluation_results(self, model_version, results):
+        """EvaluationService.on_results hook."""
+        self.log_scalars("eval", model_version, results)
+
+    def close(self):
+        if self._tb is not None:
+            self._tb.close()

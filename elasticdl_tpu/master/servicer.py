@@ -23,10 +23,14 @@ class MasterServicer:
         task_dispatcher,
         evaluation_service=None,
         membership=None,
+        worker_liveness_timeout=60.0,
     ):
         self._task_d = task_dispatcher
         self._evaluation_service = evaluation_service
         self._membership = membership
+        # Same threshold the master watchdog uses, so alive_workers in the
+        # job status can't contradict actual liveness decisions.
+        self._worker_liveness_timeout = worker_liveness_timeout
         self._lock = threading.Lock()
         # worker_id -> last-RPC wall time, for the liveness watchdog
         # (reference servicer.py:93-94).
@@ -113,6 +117,42 @@ class MasterServicer:
             coordinator_addr=coordinator,
             rendezvous_port=coordinator_port,
         )
+
+    def get_job_status(self, request, context):
+        """Telemetry for `edl top` and other monitors (the in-job analog of
+        the reference's pod-polling job monitor, k8s_job_monitor.py:94-207).
+        Workers with an RPC inside the liveness timeout count as alive."""
+        stats = self._task_d.stats()
+        now = time.time()
+        with self._lock:
+            alive = sum(
+                1
+                for ts in self.worker_liveness.values()
+                if now - ts < self._worker_liveness_timeout
+            )
+            version = self.max_model_version
+        res = pb.JobStatusResponse(
+            todo_tasks=stats["todo"],
+            doing_tasks=stats["doing"],
+            epoch=stats["epoch"],
+            num_epochs=stats["num_epochs"],
+            model_version=version,
+            alive_workers=alive,
+            finished=self._task_d.finished(),
+            job_failed=stats["job_failed"],
+            records_done=stats["records_done"],
+        )
+        if (
+            self._evaluation_service is not None
+            and self._evaluation_service.completed_results
+        ):
+            eval_version, metrics = (
+                self._evaluation_service.completed_results[-1]
+            )
+            res.last_eval_version = eval_version
+            for name, value in metrics.items():
+                res.last_eval_metrics[name] = float(value)
+        return res
 
     def report_worker_liveness(self, request, context):
         self._touch(request.worker_id)

@@ -86,6 +86,7 @@ class TaskDispatcher:
         # Rolling completion-time stats per task type, for the timeout
         # watchdog (reference master/servicer.py:131-148).
         self._task_durations = {}  # task_type -> deque of seconds (bounded)
+        self._records_done = 0  # successful TRAINING records, for monitors
         self._eval_complete_callbacks = []
         self._tasks_done_callbacks = []
 
@@ -181,6 +182,9 @@ class TaskDispatcher:
                         kept.append(task)
                 self._todo = kept
             if skipped:
+                # Seed the cumulative counter so monitors/metrics continue
+                # from the pre-restart figure instead of restarting at 0.
+                self._records_done += skipped
                 logger.info(
                     "Resume: skipping %d already-trained records "
                     "(%d full epochs)",
@@ -254,6 +258,8 @@ class TaskDispatcher:
                 self._task_durations.setdefault(
                     task.type, collections.deque(maxlen=100)
                 ).append(time.time() - start_time)
+                if task.type == pb.TRAINING:
+                    self._records_done += task.end - task.start
                 evaluation_done = task.type == pb.EVALUATION
                 job_done = self._finished_locked()
             elif self._stop_training and task.type == pb.TRAINING:
@@ -371,5 +377,17 @@ class TaskDispatcher:
         self._tasks_done_callbacks.append(cb)
 
     def counts(self):
+        stats = self.stats()
+        return {"todo": stats["todo"], "doing": stats["doing"]}
+
+    def stats(self):
+        """Telemetry snapshot for monitors / the metrics service."""
         with self._lock:
-            return {"todo": len(self._todo), "doing": len(self._doing)}
+            return {
+                "todo": len(self._todo),
+                "doing": len(self._doing),
+                "epoch": self._epoch,
+                "num_epochs": self._num_epochs,
+                "records_done": self._records_done,
+                "job_failed": self._job_failed,
+            }

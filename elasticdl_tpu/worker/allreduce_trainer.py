@@ -272,12 +272,14 @@ class AllReduceTrainer(JaxTrainer):
         sync_step = self._steps_since_check >= self._steps_per_world_check
         if sync_step:
             self._steps_since_check = 0
-            self.init_world_if_needed()
+            with self.timing.record("world_check"):
+                self.init_world_if_needed()
         features = jax.tree_util.tree_map(np.asarray, features)
         labels = jax.tree_util.tree_map(np.asarray, labels)
         for attempt in range(self._max_comm_retries):
             try:
-                loss = self._run_sharded_step(features, labels)
+                with self.timing.record("sharded_step_dispatch"):
+                    loss = self._run_sharded_step(features, labels)
                 if sync_step:
                     # Async dispatch means a collective failure surfaces on
                     # materialization, not dispatch. Block here — on the

@@ -202,35 +202,43 @@ class ParameterServerTrainer(JaxTrainer):
         device_features = _to_device_batch(features)
         device_labels = _to_device_batch(labels)
         for attempt in range(self._max_push_retries):
-            self._sync_model()
-            emb_rows, flat_ids = self._prefetch_embeddings(features)
+            with self.timing.record("pull_model"):
+                self._sync_model()
+            with self.timing.record("prefetch_embeddings"):
+                emb_rows, flat_ids = self._prefetch_embeddings(features)
             self._rng, step_rng = jax.random.split(self._rng)
             state = {
                 k: v for k, v in self._variables.items() if k != "params"
             }
-            loss, param_grads, emb_grads, new_state = self._ps_step(
-                self._variables["params"],
-                state,
-                emb_rows,
-                step_rng,
-                device_features,
-                device_labels,
-            )
-            self._variables.update(new_state)
-            dense_named, _ = flatten_params(jax.device_get(param_grads))
-            sparse = {}
-            for path, g in _walk_dict(emb_grads):
-                table = path[-1]
-                sparse[table] = (
-                    np.asarray(g).reshape(-1, self._embedding_dims[table]),
-                    flat_ids[table],
+            with self.timing.record("train_step"):
+                loss, param_grads, emb_grads, new_state = self._ps_step(
+                    self._variables["params"],
+                    state,
+                    emb_rows,
+                    step_rng,
+                    device_features,
+                    device_labels,
                 )
-            accepted, version = self._ps.push_gradients(
-                dense_named,
-                sparse,
-                version=self._version,
-                batch_size=int(np.asarray(labels).shape[0]),
-            )
+            self._variables.update(new_state)
+            with self.timing.record("push_gradients"):
+                dense_named, _ = flatten_params(
+                    jax.device_get(param_grads)
+                )
+                sparse = {}
+                for path, g in _walk_dict(emb_grads):
+                    table = path[-1]
+                    sparse[table] = (
+                        np.asarray(g).reshape(
+                            -1, self._embedding_dims[table]
+                        ),
+                        flat_ids[table],
+                    )
+                accepted, version = self._ps.push_gradients(
+                    dense_named,
+                    sparse,
+                    version=self._version,
+                    batch_size=int(np.asarray(labels).shape[0]),
+                )
             self._version = max(self._version, version)
             if accepted:
                 return True, self._version, float(loss)

@@ -78,6 +78,11 @@ class JaxTrainer(Trainer):
         # Checkpoint path to restore from right after lazy init (worker-side
         # resume for strategies whose state lives in the worker).
         self.restore_on_init = None
+        # Step-phase breakdown, reported per task at DEBUG by the worker
+        # loop (reference timing_utils.py usage in ps_trainer/worker).
+        from elasticdl_tpu.common.timing import Timing
+
+        self.timing = Timing()
 
     # ---------- init ----------
 

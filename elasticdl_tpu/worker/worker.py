@@ -14,6 +14,7 @@ from elasticdl_tpu.common.constants import (
 )
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.model_utils import Modes
+from elasticdl_tpu.common.timing import Timing
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 from elasticdl_tpu.worker.task_data_service import TaskDataService
 
@@ -45,6 +46,7 @@ class Worker:
         self._max_minibatch_retries = max_minibatch_retries
         self._metadata = data_reader.metadata
         self._steps = 0
+        self._timing = Timing()
         self._callbacks = (
             model_spec.callbacks() if model_spec.callbacks else []
         ) + list(extra_callbacks)
@@ -121,8 +123,12 @@ class Worker:
 
     def _run_task(self, task, process_batch):
         try:
-            for records in self._tds.read_batches(task, self._minibatch_size):
-                self._process_with_retries(process_batch, records)
+            with self._timing.record("task_process"):
+                for records in self._tds.read_batches(
+                    task, self._minibatch_size
+                ):
+                    with self._timing.record("batch_process"):
+                        self._process_with_retries(process_batch, records)
             self._tds.report_task(task.task_id)
         except Exception as e:
             logger.error(
@@ -132,6 +138,15 @@ class Worker:
                 traceback.format_exc(),
             )
             self._tds.report_task(task.task_id, err_message=str(e))
+        finally:
+            # Per-task phase breakdown at DEBUG (reference worker.py:380-382
+            # reports get_model/report_gradient/batch_process the same way);
+            # in the finally so a failed task's time can't leak into the
+            # next task's report.
+            self._timing.report(logger, reset=True)
+            trainer_timing = getattr(self._trainer, "timing", None)
+            if trainer_timing is not None:
+                trainer_timing.report(logger, reset=True)
 
     def _process_with_retries(self, process_batch, records):
         """Per-minibatch retry (reference worker.py:165-218): transient

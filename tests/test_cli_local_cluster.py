@@ -101,3 +101,79 @@ def test_yaml_dump_mode(tmp_path, linear_data):
     command = manifest["spec"]["containers"][0]["command"]
     assert "--yaml" not in command and yaml_path not in command
     assert manifest["spec"]["serviceAccountName"] == "elasticdl-master"
+
+
+def test_metrics_dir_and_top_monitor(tmp_path, linear_data):
+    """`edl train --metrics_dir` publishes metrics.jsonl + TB events, and
+    `edl top` polls the live master's job-status RPC until completion."""
+    import json
+    import socket
+    import subprocess as sp
+    import time
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+
+    metrics_dir = str(tmp_path / "metrics")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{REPO}:{REPO}/tests"
+    env["JAX_PLATFORMS"] = "cpu"
+    train = sp.Popen(
+        [
+            sys.executable, "-m", "elasticdl_tpu.client.main", "train",
+            "--model_zoo", f"{REPO}/tests",
+            "--model_def", "test_module",
+            "--training_data", linear_data,
+            "--num_epochs", "8",
+            "--records_per_task", "32",
+            "--minibatch_size", "32",
+            "--num_workers", "1",
+            "--distribution_strategy", "Local",
+            "--instance_backend", "local_process",
+            "--master_port", str(port),
+            "--metrics_dir", metrics_dir,
+        ],
+        stdout=sp.PIPE,
+        stderr=sp.PIPE,
+        text=True,
+        env=env,
+        cwd=REPO,
+    )
+    try:
+        # Wait for the master port, then monitor until the job ends.
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                probe = socket.create_connection(
+                    ("127.0.0.1", port), timeout=1
+                )
+                probe.close()
+                break
+            except OSError:
+                time.sleep(0.5)
+        top = sp.run(
+            [
+                sys.executable, "-m", "elasticdl_tpu.client.main", "top",
+                "--master_addr", f"127.0.0.1:{port}",
+                "--interval", "0.5",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=180,
+            env=env,
+            cwd=REPO,
+        )
+        assert top.returncode == 0, top.stderr[-2000:]
+        assert "epoch" in top.stdout and "FINISHED" in top.stdout
+        out, err = train.communicate(timeout=120)
+        assert train.returncode == 0, err[-3000:]
+    finally:
+        if train.poll() is None:
+            train.kill()
+    lines = [
+        json.loads(line)
+        for line in open(os.path.join(metrics_dir, "metrics.jsonl"))
+    ]
+    assert any(line["group"] == "train" for line in lines)

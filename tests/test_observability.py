@@ -1,0 +1,83 @@
+"""Observability: timing accumulators, metrics JSONL/TensorBoard export,
+and the job-status RPC behind `edl top` (reference analogs:
+timing_utils.py, tensorboard_service.py, k8s_job_monitor.py)."""
+
+import json
+import time
+
+from elasticdl_tpu.common import rpc
+from elasticdl_tpu.common.timing import Timing
+from elasticdl_tpu.master.metrics_service import MetricsService
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+from test_utils import start_master
+
+
+def test_timing_accumulates_and_reports():
+    t = Timing()
+    for _ in range(3):
+        with t.record("phase_a"):
+            time.sleep(0.01)
+    t.add("phase_b", 1.5)
+    s = t.summary()
+    assert s["phase_a"]["count"] == 3
+    assert s["phase_a"]["total_s"] >= 0.03
+    assert abs(s["phase_a"]["mean_s"] - s["phase_a"]["total_s"] / 3) < 1e-9
+    assert s["phase_b"]["total_s"] == 1.5
+    t.reset()
+    assert t.summary() == {}
+
+
+def test_timing_disabled_is_free():
+    t = Timing(enabled=False)
+    with t.record("x"):
+        pass
+    t.add("y", 1.0)
+    assert t.summary() == {}
+
+
+def test_metrics_service_writes_jsonl_and_tb(tmp_path):
+    ms = MetricsService(str(tmp_path))
+    ms.log_scalars("train", 10, {"records_per_sec": 123.4, "epoch": 1})
+    ms.on_evaluation_results(20, {"accuracy": 0.75})
+    ms.close()
+    lines = [
+        json.loads(line)
+        for line in (tmp_path / "metrics.jsonl").read_text().splitlines()
+    ]
+    assert lines[0]["group"] == "train" and lines[0]["step"] == 10
+    assert lines[0]["records_per_sec"] == 123.4
+    assert lines[1]["group"] == "eval" and lines[1]["accuracy"] == 0.75
+    # TensorBoard event files appear when a SummaryWriter is available
+    # (torch.utils.tensorboard in this image).
+    assert any(
+        "tfevents" in p.name for p in tmp_path.iterdir()
+    ), "expected TB event file alongside metrics.jsonl"
+
+
+def test_get_job_status_rpc():
+    with start_master(
+        training_shards={"f": (0, 40)}, records_per_task=20
+    ) as m:
+        stub = rpc.Stub(rpc.build_channel(m["addr"]), rpc.MASTER_SERVICE)
+        status = stub.get_job_status(pb.GetJobStatusRequest())
+        assert status.todo_tasks == 2 and status.doing_tasks == 0
+        assert status.epoch == 1 and not status.finished
+
+        task = stub.get_task(pb.GetTaskRequest(worker_id=3))
+        status = stub.get_job_status(pb.GetJobStatusRequest())
+        assert status.todo_tasks == 1 and status.doing_tasks == 1
+        assert status.alive_workers == 1  # worker 3 touched liveness
+
+        stub.report_task_result(
+            pb.ReportTaskResultRequest(task_id=task.task_id)
+        )
+        status = stub.get_job_status(pb.GetJobStatusRequest())
+        assert status.records_done == 20
+
+        task2 = stub.get_task(pb.GetTaskRequest(worker_id=3))
+        stub.report_task_result(
+            pb.ReportTaskResultRequest(task_id=task2.task_id)
+        )
+        status = stub.get_job_status(pb.GetJobStatusRequest())
+        assert status.finished and status.records_done == 40
