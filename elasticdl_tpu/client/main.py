@@ -255,11 +255,22 @@ def _top(args):
                 continue
             if last_status is not None and last_status.finished:
                 return 1 if last_status.job_failed else 0
-            print(
-                f"master {args.master_addr} unreachable "
-                f"({e.code().name}); job likely ended",
-                flush=True,
-            )
+            if last_status is not None:
+                # Lost the master mid-job: distinct exit code — a crashed
+                # master and a finished job must not look alike to CI.
+                print(
+                    f"master {args.master_addr} gone mid-job "
+                    f"(last: epoch {last_status.epoch}, "
+                    f"v{last_status.model_version}, "
+                    f"records={last_status.records_done})",
+                    flush=True,
+                )
+            else:
+                print(
+                    f"master {args.master_addr} unreachable "
+                    f"({e.code().name})",
+                    flush=True,
+                )
             return 2
         errors = 0
         last_status = status

@@ -1,0 +1,335 @@
+"""Automatic embedding placement for the PS strategy (the ModelHandler).
+
+Reference counterpart: /root/reference/elasticdl/python/common/
+model_handler.py:98-102,148-461 — the reference clones a Keras model,
+replacing every `tf.keras.layers.Embedding` whose table exceeds 2 MB with
+the PS-backed EDL Embedding, and reverses the swap (stuffing trained
+checkpoint weights back) for SavedModel export.
+
+TPU-first redesign: flax modules are immutable dataclass trees, so instead
+of graph surgery the swap happens at TRACE time via
+`flax.linen.intercept_methods`:
+
+- `wrap_model_for_ps(model)` returns a wrapper module whose interceptor
+  (a) skips `setup` for every `nn.Embed` above the size threshold, so the
+      giant table param is never created, and
+  (b) replaces its `__call__` with a read of per-position rows from the
+      `edl_embedding` collection (keyed by the embed's module path) — the
+      exact contract ParameterServerTrainer already speaks for
+      DistributedEmbedding, so the trainer needs no new code path.
+  Models with no over-threshold embeds come back unchanged (the caller
+  checks `discover_swapped_tables`).
+
+- `derive_embedding_inputs(...)` removes the hand-written
+  `embedding_inputs` feed: a one-off EAGER capture pass records the
+  concrete ids each swapped table consumed, then matches them against the
+  feature pytree (exact leaf, column slice, or flatten) to synthesize the
+  feed function. Models whose ids are computed (hashed/crossed) inside the
+  forward pass fall back to a per-batch eager capture feed.
+
+- `stuff_export_params(...)` is the reverse swap: trained PS table rows are
+  materialized back into the ORIGINAL (unwrapped) model's param tree as
+  plain `embedding` tables, so the exported checkpoint loads into the
+  user's stock model exactly as the reference's export rewrite does.
+"""
+
+import contextvars
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.pytree_utils import get_at as _get_at, walk_dict
+from elasticdl_tpu.layers.embedding import (
+    EMBEDDING_COLLECTION,
+    DistributedEmbedding,
+)
+
+logger = get_logger("common.model_handler")
+
+# The reference partitions a table to the PS iff it exceeds 2 MB
+# (model_handler.py:98-102).
+DEFAULT_THRESHOLD_BYTES = 2 * 1024 * 1024
+
+# When set (to a dict), swapped-embed interceptors record
+# {table_name: np ids} instead of contributing to training.
+_CAPTURE = contextvars.ContextVar("edl_capture", default=None)
+# When set (to a dict), swapped-embed calls record
+# {table_name: (dim, vocab)} — the declared table geometry, used to size
+# the export reverse-swap exactly as the stock model declares it.
+_DISCOVER = contextvars.ContextVar("edl_discover", default=None)
+
+
+class discover_tables:
+    """Context manager collecting {table: (dim, vocab)} during a wrapped
+    model's init/apply."""
+
+    def __enter__(self):
+        self.tables = {}
+        self._token = _DISCOVER.set(self.tables)
+        return self.tables
+
+    def __exit__(self, *exc):
+        _DISCOVER.reset(self._token)
+        return False
+
+
+def _table_name(module):
+    """Module path -> PS table key, with the wrapper's own 'inner' segment
+    stripped so the name matches the ORIGINAL model's tree (what the export
+    reverse-swap stuffs into)."""
+    path = [p for p in module.path if p]
+    if path and path[0] == "inner":
+        path = path[1:]
+    return "/".join(path)
+
+
+def _oversized(module, threshold_bytes):
+    if not isinstance(module, nn.Embed):
+        return False
+    bytes_ = module.num_embeddings * module.features * np.dtype(
+        module.dtype or jnp.float32
+    ).itemsize
+    return bytes_ > threshold_bytes
+
+
+def _combined_zeros(module, ids):
+    """Zero output of a DistributedEmbedding call, shaped per its combiner
+    (capture mode short-circuits the real lookup)."""
+    ids = jnp.asarray(ids)
+    if module.combiner is None:
+        shape = ids.shape + (module.dim,)
+    else:
+        shape = ids.shape[:-1] + (module.dim,)
+    return jnp.zeros(shape, jnp.float32)
+
+
+class PSWrappedModel(nn.Module):
+    """Wraps a user model, rerouting oversized `nn.Embed`s to the PS."""
+
+    inner: nn.Module
+    threshold_bytes: int = DEFAULT_THRESHOLD_BYTES
+
+    @nn.compact
+    def __call__(self, *args, **kwargs):
+        outer = self
+        calls_seen = set()  # tables applied so far in THIS forward
+
+        def interceptor(next_fun, fargs, fkwargs, context):
+            mod = context.module
+            if _oversized(mod, outer.threshold_bytes):
+                if context.method_name == "setup":
+                    # The swap: never declare the giant table param.
+                    return None
+                if context.method_name == "__call__":
+                    ids = jnp.asarray(fargs[0])
+                    table = _table_name(mod)
+                    if table in calls_seen:
+                        # One shared table applied at two call sites would
+                        # collide on the collection key and silently train
+                        # against the wrong ids — refuse instead.
+                        raise ValueError(
+                            f"embedding table {table!r} is applied more "
+                            "than once per forward pass; automatic PS "
+                            "placement does not support shared tables — "
+                            "use DistributedEmbedding with an explicit "
+                            "embedding_inputs feed"
+                        )
+                    calls_seen.add(table)
+                    discover = _DISCOVER.get()
+                    if discover is not None:
+                        discover[table] = (
+                            mod.features,
+                            mod.num_embeddings,
+                        )
+                    capture = _CAPTURE.get()
+                    if capture is not None:
+                        # Capture mode: record ids, touch no variables (the
+                        # caller has no collection to provide).
+                        capture[table] = np.asarray(ids)
+                        return jnp.zeros(
+                            ids.shape + (mod.features,), jnp.float32
+                        )
+                    rows = outer.variable(
+                        EMBEDDING_COLLECTION,
+                        table,
+                        lambda: jnp.zeros(
+                            (ids.size, mod.features), jnp.float32
+                        ),
+                    )
+                    return rows.value.reshape(
+                        ids.shape + (mod.features,)
+                    )
+            elif (
+                isinstance(mod, DistributedEmbedding)
+                and context.method_name == "__call__"
+            ):
+                capture = _CAPTURE.get()
+                if capture is not None:
+                    capture[mod.table_name] = np.asarray(fargs[0])
+                    return _combined_zeros(mod, fargs[0])
+            return next_fun(*fargs, **fkwargs)
+
+        with nn.intercept_methods(interceptor):
+            return self.inner(*args, **kwargs)
+
+
+def wrap_model_for_ps(model, threshold_bytes=DEFAULT_THRESHOLD_BYTES):
+    return PSWrappedModel(inner=model, threshold_bytes=threshold_bytes)
+
+
+class _CaptureDistributed(nn.Module):
+    """Capture-only wrapper for models built directly on
+    DistributedEmbedding (no swap needed, but the feed can still be
+    derived automatically)."""
+
+    inner: nn.Module
+
+    @nn.compact
+    def __call__(self, *args, **kwargs):
+        def interceptor(next_fun, fargs, fkwargs, context):
+            mod = context.module
+            if (
+                isinstance(mod, DistributedEmbedding)
+                and context.method_name == "__call__"
+            ):
+                capture = _CAPTURE.get()
+                if capture is not None:
+                    capture[mod.table_name] = np.asarray(fargs[0])
+                    return _combined_zeros(mod, fargs[0])
+            return next_fun(*fargs, **fkwargs)
+
+        with nn.intercept_methods(interceptor):
+            return self.inner(*args, **kwargs)
+
+
+def capture_embedding_ids(model, variables, features):
+    """Eager forward solely to observe which ids each table consumed.
+    Works for PSWrappedModel (swapped nn.Embeds) and, via a transient
+    capture wrapper, for DistributedEmbedding models."""
+    capture = {}
+    token = _CAPTURE.set(capture)
+    try:
+        runner = (
+            model
+            if isinstance(model, PSWrappedModel)
+            else _CaptureDistributed(inner=model)
+        )
+        if not isinstance(model, PSWrappedModel):
+            variables = {"params": {"inner": variables["params"]}, **{
+                k: {"inner": v}
+                for k, v in variables.items()
+                if k != "params"
+            }}
+        runner.apply(variables, features, training=False)
+    finally:
+        _CAPTURE.reset(token)
+    return capture
+
+
+def _match_leaf(ids, leaf):
+    """Return an extractor leaf_array -> ids_array, or None. Covers the
+    ways zoo models feed id features to embedding layers: the whole leaf,
+    a single column of a [B, F] leaf, or a reshape of the leaf."""
+    if ids.shape == leaf.shape and np.array_equal(ids, leaf):
+        return lambda a: a
+    if (
+        leaf.ndim == 2
+        and ids.ndim == 1
+        and ids.shape[0] == leaf.shape[0]
+    ):
+        for j in range(leaf.shape[1]):
+            if np.array_equal(ids, leaf[:, j]):
+                return lambda a, j=j: a[:, j]
+    if ids.size == leaf.size and np.array_equal(
+        ids.reshape(-1), leaf.reshape(-1)
+    ):
+        shape_tail = ids.shape[1:]
+        return lambda a, t=shape_tail: a.reshape((a.shape[0],) + t)
+    return None
+
+
+def derive_embedding_inputs(model, variables, sample_features):
+    """Synthesize the `embedding_inputs` feed: features -> {table: ids}.
+
+    Matches each table's captured ids against the feature pytree; any
+    table whose ids are computed inside the model falls back to a
+    per-batch eager capture (general, slower — logged once)."""
+    captured = capture_embedding_ids(model, variables, sample_features)
+    if not captured:
+        return None
+    extractors = {}
+    unmatched = []
+    leaves = [
+        (path, np.asarray(leaf))
+        for path, leaf in walk_dict(sample_features)
+    ]
+    for table, ids in captured.items():
+        found = None
+        for path, leaf in leaves:
+            ex = _match_leaf(ids, leaf)
+            if ex is not None:
+                found = (path, ex)
+                break
+        if found is None:
+            unmatched.append(table)
+        else:
+            extractors[table] = found
+    if unmatched:
+        logger.info(
+            "Tables %s compute ids inside the forward pass; using a "
+            "per-batch capture feed for them",
+            unmatched,
+        )
+
+        def feed(features):
+            out = capture_embedding_ids(model, variables, features)
+            for table, (path, ex) in extractors.items():
+                out[table] = np.asarray(
+                    ex(np.asarray(_get_at(features, path)))
+                )
+            return out
+
+        return feed
+
+    def feed(features):
+        return {
+            table: np.asarray(ex(np.asarray(_get_at(features, path))))
+            for table, (path, ex) in extractors.items()
+        }
+
+    return feed
+
+
+def stuff_export_params(params, ps_tables, default_vocab=None):
+    """Reverse swap for export: inject trained PS table rows back into the
+    ORIGINAL model's param tree (reference model_handler.py:242-268).
+
+    params: the INNER model's params (wrapper nesting already stripped).
+    ps_tables: {table_name ('a/b' path form): (ids, values)} from the PS.
+    default_vocab: {table_name: vocab_size} for sizing; defaults to
+    max(id)+1.
+    Unseen rows stay zero — ids never looked up were never trained.
+    """
+    params = _deep(params)
+    for table, (ids, values) in ps_tables.items():
+        ids = np.asarray(ids)
+        values = np.asarray(values)
+        vocab = (default_vocab or {}).get(
+            table, int(ids.max()) + 1 if ids.size else 0
+        )
+        full = np.zeros((vocab, values.shape[1]), values.dtype)
+        full[ids] = values
+        node = params
+        parts = table.split("/")
+        for k in parts[:-1]:
+            node = node.setdefault(k, {})
+        node.setdefault(parts[-1], {})["embedding"] = full
+    return params
+
+
+def _deep(tree):
+    return {
+        k: _deep(v) if hasattr(v, "items") else v for k, v in tree.items()
+    }

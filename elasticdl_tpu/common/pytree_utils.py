@@ -31,3 +31,31 @@ def unflatten_like(params, named):
     for path, leaf in flat:
         leaves.append(named.get(_path_name(path), leaf))
     return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def walk_dict(tree, path=()):
+    """Yield (path_tuple, leaf) over a nested mapping (dict or flax
+    FrozenDict)."""
+    for k, v in tree.items():
+        if hasattr(v, "items"):
+            yield from walk_dict(v, path + (k,))
+        else:
+            yield path + (k,), v
+
+
+def nest_at(paths_to_values):
+    """{path_tuple: value} -> nested dict."""
+    nested = {}
+    for path, value in paths_to_values.items():
+        node = nested
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = value
+    return nested
+
+
+def get_at(tree, path):
+    node = tree
+    for k in path:
+        node = node[k]
+    return node

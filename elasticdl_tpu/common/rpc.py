@@ -61,6 +61,10 @@ PSERVER_SERVICE = ServiceSpec(
             pb.PullDenseParametersResponse,
         ),
         "pull_embedding_vectors": (pb.PullEmbeddingVectorsRequest, pb.Tensor),
+        "pull_embedding_table": (
+            pb.PullEmbeddingTableRequest,
+            pb.IndexedSlices,
+        ),
         "push_gradients": (pb.PushGradientsRequest, pb.PushGradientsResponse),
     },
 )

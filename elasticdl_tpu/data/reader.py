@@ -38,11 +38,14 @@ class AbstractDataReader(ABC):
 
 
 class RecordFileReader(AbstractDataReader):
-    """Reads .edlr record files; one shard per file."""
+    """Reads .edlr record files; one shard per file. `data_origin` may be
+    a directory (every *.edlr inside becomes a shard) or one .edlr file
+    (exactly that file — siblings in the same directory are NOT pulled in,
+    they may belong to other datasets)."""
 
-    def __init__(self, data_dir, **kwargs):
+    def __init__(self, data_origin, **kwargs):
         super().__init__(**kwargs)
-        self._data_dir = data_dir
+        self._origin = data_origin
         self._files = {}  # path -> RecordFile, opened lazily and cached
 
     def _record_file(self, path):
@@ -55,11 +58,17 @@ class RecordFileReader(AbstractDataReader):
         yield from rf.read(task.start, task.end - task.start)
 
     def create_shards(self):
-        shards = {}
-        for path in sorted(glob.glob(os.path.join(self._data_dir, "*.edlr"))):
-            shards[path] = (0, RecordFile(path).num_records)
+        if os.path.isdir(self._origin):
+            paths = sorted(
+                glob.glob(os.path.join(self._origin, "*.edlr"))
+            )
+        else:
+            paths = [self._origin] if os.path.exists(self._origin) else []
+        shards = {
+            path: (0, RecordFile(path).num_records) for path in paths
+        }
         if not shards:
-            raise ValueError(f"no .edlr record files under {self._data_dir}")
+            raise ValueError(f"no .edlr record files at {self._origin}")
         return shards
 
     def close(self):
@@ -174,6 +183,5 @@ def create_data_reader(data_origin, records_per_task=None, **kwargs):
     if data_origin.endswith(".csv"):
         return CSVDataReader(data_origin, **kwargs)
     if data_origin.endswith(".edlr"):
-        d = os.path.dirname(data_origin) or "."
-        return RecordFileReader(d, **kwargs)
+        return RecordFileReader(data_origin, **kwargs)
     raise ValueError(f"cannot infer a data reader for: {data_origin!r}")

@@ -315,6 +315,11 @@ class Master:
             while True:
                 if self.task_d.finished():
                     logger.info("All tasks complete; job done")
+                    # Brief linger so monitors polling get_job_status can
+                    # observe the terminal state before the server stops.
+                    time.sleep(
+                        getattr(self.args, "shutdown_linger_seconds", 2.0)
+                    )
                     return 1 if self.task_d.job_failed else 0
                 if self.task_d.job_failed:
                     logger.error("Job failed (task retries exhausted)")

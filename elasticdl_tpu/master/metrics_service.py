@@ -51,7 +51,12 @@ class MetricsService:
 
     def log_scalars(self, group, step, scalars):
         """scalars: {name: number}; step: model version / global step."""
-        clean = {k: float(v) for k, v in scalars.items()}
+        clean = {}
+        for k, v in scalars.items():
+            # A user metric named like a metadata field must not clobber
+            # the record's ts/group/step.
+            key = f"metric_{k}" if k in ("ts", "group", "step") else k
+            clean[key] = float(v)
         line = json.dumps(
             {"ts": time.time(), "group": group, "step": int(step), **clean}
         )

@@ -169,13 +169,18 @@ class EmbeddingTable:
 
     # ---------- checkpoint export/import ----------
 
-    def export_rows(self):
-        """(ids, values) for every materialized id, row-aligned."""
+    def export_rows(self, start=0, count=None):
+        """(ids, values) for materialized ids in stable insertion order,
+        row-aligned. `start`/`count` page through the table (new ids only
+        ever append, so earlier pages stay stable while paging)."""
         with self._lock:
             ids = self.ids
             rows = np.fromiter(
                 self._id_to_row.values(), dtype=np.int64, count=len(ids)
             )
+            if count is not None or start:
+                end = len(ids) if count is None else start + count
+                ids, rows = ids[start:end], rows[start:end]
             return ids, self._slab[rows].copy()
 
     def import_rows(self, ids, values):

@@ -125,6 +125,21 @@ class PserverServicer:
         values = table.lookup(np.asarray(request.ids, dtype=np.int64))
         return tensor_utils.ndarray_to_tensor_pb(values, request.name)
 
+    def pull_embedding_table(self, request, context):
+        """One page of a table's materialized rows — the export
+        reverse-swap (model export stuffs these back into a plain
+        embedding param). Paged so CTR-scale tables fit the message cap."""
+        table = self._params.embedding_tables.get(request.name)
+        if table is None:
+            raise ValueError(f"unknown embedding table {request.name!r}")
+        ids, values = table.export_rows(
+            start=request.start_row,
+            count=request.max_rows or None,
+        )
+        return tensor_utils.ndarray_to_indexed_slices_pb(
+            values, ids, request.name
+        )
+
     def push_gradients(self, request, context):
         if self._use_async:
             return self._push_async(request)

@@ -37,6 +37,9 @@ def build_trainer(args, spec, master_client):
             optimizer_spec,
             PSClient(args.ps_addrs.split(","), worker_id=args.worker_id),
             embedding_inputs=getattr(spec.module, "embedding_inputs", None),
+            embedding_threshold_bytes=getattr(
+                spec.module, "embedding_threshold_bytes", None
+            ),
             seed=args.seed,
         )
     if strategy == DistributionStrategy.ALLREDUCE:

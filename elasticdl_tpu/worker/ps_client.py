@@ -145,6 +145,35 @@ class PSClient:
             out[positions] = values
         return out
 
+    def pull_embedding_table(self, name, page_bytes=64 << 20):
+        """Every materialized (id, row) of a table, merged across shards —
+        the export reverse-swap. Pulled in pages so a CTR-scale table
+        never has to fit one gRPC message (256 MB cap). Returns
+        (ids [n], values [n, dim]); (empty, None) if no rows exist."""
+        all_ids, all_values = [], []
+        for stub in self._stubs:
+            start, requested = 0, 65536  # re-sized once dim is known
+            while True:
+                res = stub.pull_embedding_table(
+                    pb.PullEmbeddingTableRequest(
+                        name=name, start_row=start, max_rows=requested
+                    )
+                )
+                values, ids = tensor_utils.indexed_slices_pb_to_ndarrays(
+                    res
+                )
+                if ids.size:
+                    all_ids.append(ids)
+                    all_values.append(values)
+                if ids.size < requested:  # short page = last page
+                    break
+                start += ids.size
+                row_bytes = values.dtype.itemsize * values.shape[1]
+                requested = max(1, page_bytes // max(row_bytes, 1))
+        if not all_ids:
+            return np.empty(0, np.int64), None
+        return np.concatenate(all_ids), np.concatenate(all_values)
+
     # ---------- gradient push ----------
 
     def push_gradients(

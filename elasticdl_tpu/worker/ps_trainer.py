@@ -22,7 +22,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from elasticdl_tpu.common.log_utils import get_logger
-from elasticdl_tpu.common.pytree_utils import flatten_params, unflatten_like
+from elasticdl_tpu.common.pytree_utils import (
+    flatten_params,
+    nest_at as _nest_at,
+    unflatten_like,
+    walk_dict as _walk_dict,
+)
 from elasticdl_tpu.layers.embedding import EMBEDDING_COLLECTION
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 from elasticdl_tpu.worker.trainer import JaxTrainer, _to_device_batch
@@ -30,27 +35,6 @@ from elasticdl_tpu.worker.trainer import JaxTrainer, _to_device_batch
 logger = get_logger("worker.ps_trainer")
 
 DEFAULT_MAX_PUSH_RETRIES = 3
-
-
-def _walk_dict(tree, path=()):
-    """Yield (path_tuple, leaf) over a nested dict (flax FrozenDict or dict).
-    """
-    for k, v in tree.items():
-        if hasattr(v, "items"):
-            yield from _walk_dict(v, path + (k,))
-        else:
-            yield path + (k,), v
-
-
-def _nest_at(paths_to_values):
-    """{path_tuple: value} -> nested dict."""
-    nested = {}
-    for path, value in paths_to_values.items():
-        node = nested
-        for k in path[:-1]:
-            node = node.setdefault(k, {})
-        node[path[-1]] = value
-    return nested
 
 
 class ParameterServerTrainer(JaxTrainer):
@@ -61,15 +45,18 @@ class ParameterServerTrainer(JaxTrainer):
         optimizer_spec,
         ps_client,
         embedding_inputs=None,
+        embedding_threshold_bytes=None,
         use_async=True,
         max_push_retries=DEFAULT_MAX_PUSH_RETRIES,
         seed=0,
     ):
         super().__init__(model, loss_fn, optimizer_spec, seed=seed)
         self._ps = ps_client
-        # callable(features) -> {table_name: ids ndarray}; required iff the
-        # model contains DistributedEmbedding layers (PS mode).
+        # callable(features) -> {table_name: ids ndarray}. Optional: when
+        # omitted, the ModelHandler auto-swaps oversized nn.Embed tables
+        # to the PS and derives the feed by id capture (init below).
         self._embedding_inputs = embedding_inputs
+        self._embedding_threshold_bytes = embedding_threshold_bytes
         self._use_async = use_async
         self._max_push_retries = max_push_retries
         self._param_names = None
@@ -79,13 +66,42 @@ class ParameterServerTrainer(JaxTrainer):
         self._embedding_paths = {}
         self._ps_step = None
         self._ps_forward = None
+        # Set when the ModelHandler wrapped the user model (auto embedding
+        # placement); export unwraps back to this original module's tree.
+        self._inner_model = None
+        self._embedding_vocab = {}  # table -> declared vocab (auto mode)
 
     # ---------- init ----------
 
     def init_variables_if_needed(self, features):
         if self._variables is not None:
             return
-        super().init_variables_if_needed(features)
+        auto = self._embedding_inputs is None
+        if auto:
+            # ModelHandler pass (common/model_handler.py): reroute any
+            # nn.Embed over the size threshold to the PS. The wrapper is
+            # discarded below if nothing swapped, so small models keep
+            # their unprefixed param tree.
+            from elasticdl_tpu.common.model_handler import (
+                DEFAULT_THRESHOLD_BYTES,
+                discover_tables,
+                wrap_model_for_ps,
+            )
+
+            self._inner_model = self._model
+            self._model = wrap_model_for_ps(
+                self._model,
+                self._embedding_threshold_bytes
+                or DEFAULT_THRESHOLD_BYTES,
+            )
+            with discover_tables() as discovered:
+                super().init_variables_if_needed(features)
+            # {table: (dim, vocab)} — vocab sizes the export reverse-swap.
+            self._embedding_vocab = {
+                t: vocab for t, (_, vocab) in discovered.items()
+            }
+        else:
+            super().init_variables_if_needed(features)
         # The init-created embedding collection only carried shapes; rows
         # arrive per-batch. Record each table's dim and scope path, then
         # drop the collection from state.
@@ -94,12 +110,32 @@ class ParameterServerTrainer(JaxTrainer):
             table = path[-1]  # innermost key is the table_name
             self._embedding_dims[table] = int(leaf.shape[-1])
             self._embedding_paths[table] = path
+        if auto and not self._embedding_dims:
+            # Nothing swapped and no DistributedEmbedding layers: drop the
+            # wrapper and re-init so param names stay unprefixed.
+            self._model = self._inner_model
+            self._inner_model = None
+            self._variables = None
+            super().init_variables_if_needed(features)
+            self._variables.pop(EMBEDDING_COLLECTION, None)
         if self._embedding_dims and self._embedding_inputs is None:
-            raise ValueError(
-                "model has DistributedEmbedding layers "
-                f"{sorted(self._embedding_dims)} but no embedding_inputs "
-                "feed was provided to ParameterServerTrainer"
+            # Derive the feed the reference's ModelHandler made implicit:
+            # capture which ids each table consumed on this first batch
+            # and match them back to feature leaves.
+            from elasticdl_tpu.common.model_handler import (
+                derive_embedding_inputs,
             )
+
+            self._embedding_inputs = derive_embedding_inputs(
+                self._model, self._variables, features
+            )
+            if self._embedding_inputs is None:
+                raise ValueError(
+                    "model has PS-resident embedding tables "
+                    f"{sorted(self._embedding_dims)} but the ids feed "
+                    "could not be derived; provide embedding_inputs in "
+                    "the model spec"
+                )
         _, self._param_names = flatten_params(self._variables["params"])
         # First worker seeds the PS; later pushes are ignored there.
         self._push_local_model()
@@ -263,3 +299,35 @@ class ParameterServerTrainer(JaxTrainer):
 
     def get_model_version(self):
         return self._version
+
+    def export_variables(self):
+        """Export with the reverse swap (reference model_handler.py:242-268):
+        pull final dense params AND full embedding tables from the PS, stuff
+        tables back into the ORIGINAL model's param tree as plain
+        `embedding` params, and strip the ModelHandler wrapper's nesting so
+        the checkpoint loads into the user's stock model."""
+        if self._variables is None:
+            return None
+        self._sync_model()
+        variables = jax.device_get(dict(self._variables))
+        params = variables["params"]
+        if self._inner_model is not None:
+            params = params.get("inner", params)
+            ps_tables = {}
+            for table in self._embedding_dims:
+                ids, values = self._ps.pull_embedding_table(table)
+                if values is not None:
+                    ps_tables[table] = (ids, values)
+            from elasticdl_tpu.common.model_handler import (
+                stuff_export_params,
+            )
+
+            params = stuff_export_params(
+                params, ps_tables, default_vocab=self._embedding_vocab
+            )
+            variables = {
+                k: (v.get("inner", v) if hasattr(v, "get") else v)
+                for k, v in variables.items()
+            }
+        variables["params"] = params
+        return {"variables": variables, "version": self._version}

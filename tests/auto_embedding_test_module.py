@@ -1,0 +1,86 @@
+"""Model spec using a PLAIN nn.Embed and NO embedding_inputs feed — the
+ModelHandler must auto-swap the table to the PS and derive the feed
+(reference model_handler.py behavior: users write stock models)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.data.example import batch_examples, encode_example
+from elasticdl_tpu.ops import optimizers
+
+VOCAB = 20
+EMB_DIM = 4
+DENSE_DIM = 3
+IDS_PER_EXAMPLE = 2
+
+# Tiny test tables: swap anything over 64 bytes (the item table is
+# VOCAB*EMB_DIM*4 = 320 B; the flag table is 3*2*4 = 24 B and stays local).
+embedding_threshold_bytes = 64
+
+
+class AutoEmbeddingModel(nn.Module):
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        item = nn.Embed(
+            num_embeddings=VOCAB, features=EMB_DIM, name="item_emb"
+        )(features["ids"])
+        flag = nn.Embed(num_embeddings=3, features=2, name="flag_emb")(
+            features["flag"]
+        )
+        h = jnp.concatenate(
+            [item.sum(axis=-2), flag, features["x"]], axis=-1
+        )
+        return nn.Dense(1)(h)
+
+
+def custom_model():
+    return AutoEmbeddingModel()
+
+
+def loss(labels, predictions):
+    return jnp.mean((predictions.reshape(-1) - labels.reshape(-1)) ** 2)
+
+
+def optimizer():
+    return optimizers.sgd(learning_rate=0.05)
+
+
+def feed(records, mode, metadata):
+    batch = batch_examples(records)
+    labels = batch.get("y")
+    return (
+        {"ids": batch["ids"], "x": batch["x"], "flag": batch["flag"]},
+        labels,
+    )
+
+
+def eval_metrics_fn():
+    return {}
+
+
+# Ground truth: fixed random table + linear head, exactly representable.
+_rng = np.random.default_rng(7)
+TRUE_TABLE = _rng.normal(scale=0.5, size=(VOCAB, EMB_DIM)).astype(np.float32)
+TRUE_WE = _rng.normal(size=(EMB_DIM,)).astype(np.float32)
+TRUE_WX = _rng.normal(size=(DENSE_DIM,)).astype(np.float32)
+
+
+def make_records(n, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, VOCAB, size=(n, IDS_PER_EXAMPLE)).astype(np.int64)
+    flag = rng.integers(0, 3, size=(n,)).astype(np.int64)
+    x = rng.normal(size=(n, DENSE_DIM)).astype(np.float32)
+    emb_sum = TRUE_TABLE[ids].sum(axis=1)
+    y = (emb_sum @ TRUE_WE + x @ TRUE_WX).astype(np.float32)
+    return [
+        encode_example(
+            {
+                "ids": ids[i],
+                "flag": flag[i],
+                "x": x[i],
+                "y": np.float32(y[i]),
+            }
+        )
+        for i in range(n)
+    ]

@@ -106,3 +106,18 @@ def test_in_memory_reader_and_factory(tmp_path):
     assert isinstance(create_data_reader(str(p)), CSVDataReader)
     with pytest.raises(ValueError):
         create_data_reader("wat.xyz")
+
+
+def test_single_edlr_file_shards_only_itself(tmp_path):
+    """Pointing at one .edlr file must NOT pull sibling files of the same
+    directory into the shard set (they may belong to other datasets)."""
+    from elasticdl_tpu.data.reader import create_data_reader
+
+    for name, n in (("a.edlr", 5), ("b.edlr", 7)):
+        with RecordFileWriter(str(tmp_path / name)) as w:
+            for i in range(n):
+                w.write(b"r%d" % i)
+    single = create_data_reader(str(tmp_path / "a.edlr"))
+    assert list(single.create_shards().values()) == [(0, 5)]
+    both = create_data_reader(str(tmp_path))
+    assert sorted(both.create_shards().values()) == [(0, 5), (0, 7)]

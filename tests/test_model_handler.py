@@ -1,0 +1,220 @@
+"""ModelHandler: automatic PS embedding placement + feed derivation +
+export reverse-swap (reference model_handler.py:98-102,148-461)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import auto_embedding_test_module as auto_mod
+from elasticdl_tpu.common.model_handler import (
+    derive_embedding_inputs,
+    stuff_export_params,
+    wrap_model_for_ps,
+)
+from elasticdl_tpu.common.model_utils import get_model_spec
+from elasticdl_tpu.data.reader import InMemoryReader
+from elasticdl_tpu.layers.embedding import EMBEDDING_COLLECTION
+from elasticdl_tpu.ps.parameter_server import ParameterServer
+from elasticdl_tpu.worker.ps_client import PSClient
+from elasticdl_tpu.worker.ps_trainer import ParameterServerTrainer
+
+from test_ps_trainer_e2e import make_ps_worker, start_pservers
+from test_utils import start_master
+
+
+def _sample_features(n=8):
+    records = auto_mod.make_records(n)
+    feats, labels = auto_mod.feed(records, "training", None)
+    return feats, labels
+
+
+def test_wrap_swaps_only_oversized_tables():
+    model = wrap_model_for_ps(
+        auto_mod.custom_model(), threshold_bytes=64
+    )
+    feats, _ = _sample_features()
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, feats, training=False
+    )
+    params = variables["params"]["inner"]
+    # The 320-byte item table swapped to the PS collection; the 24-byte
+    # flag table stayed an ordinary param.
+    assert "item_emb" not in params
+    assert params["flag_emb"]["embedding"].shape == (3, 2)
+    emb = variables[EMBEDDING_COLLECTION]
+    assert set(emb) == {"item_emb"}
+    assert emb["item_emb"].shape == (8 * auto_mod.IDS_PER_EXAMPLE, 4)
+
+
+def test_derive_embedding_inputs_exact_and_column():
+    model = wrap_model_for_ps(
+        auto_mod.custom_model(), threshold_bytes=64
+    )
+    feats, _ = _sample_features()
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, feats, training=False
+    )
+    feed = derive_embedding_inputs(model, dict(variables), feats)
+    assert feed is not None
+    # The derived feed must track NEW batches, not echo the sample.
+    feats2, _ = _sample_features(n=5)
+    out = feed(feats2)
+    np.testing.assert_array_equal(out["item_emb"], feats2["ids"])
+
+
+def test_derive_embedding_inputs_computed_ids_fallback():
+    """ids transformed inside the model can't match a feature leaf; the
+    derived feed must fall back to per-batch capture and still be right."""
+
+    class Computed(nn.Module):
+        @nn.compact
+        def __call__(self, features, training=False):
+            ids = (features["ids"] * 3 + 1) % 17
+            e = nn.Embed(num_embeddings=17, features=4, name="t")(ids)
+            return e.sum(axis=-2) @ jnp.ones((4, 1))
+
+    model = wrap_model_for_ps(Computed(), threshold_bytes=16)
+    feats, _ = _sample_features()
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, feats, training=False
+    )
+    feed = derive_embedding_inputs(model, dict(variables), feats)
+    feats2, _ = _sample_features(n=3)
+    out = feed(feats2)
+    np.testing.assert_array_equal(
+        out["t"], (feats2["ids"] * 3 + 1) % 17
+    )
+
+
+def test_stuff_export_params():
+    params = {"head": {"kernel": np.ones((2, 1))}}
+    ids = np.array([0, 3, 5])
+    values = np.arange(12, dtype=np.float32).reshape(3, 4)
+    out = stuff_export_params(params, {"deep/item": (ids, values)})
+    table = out["deep"]["item"]["embedding"]
+    assert table.shape == (6, 4)
+    np.testing.assert_array_equal(table[3], values[1])
+    np.testing.assert_array_equal(table[1], 0.0)
+    assert out["head"]["kernel"] is params["head"]["kernel"]
+
+
+def test_auto_embedding_ps_training_e2e():
+    """Stock nn.Embed model, NO embedding_inputs anywhere: the trainer must
+    swap the table, derive the feed, converge, and export a checkpoint
+    that loads into the ORIGINAL model (reverse swap)."""
+    spec = get_model_spec("auto_embedding_test_module")
+    servers, addrs = start_pservers(2, spec)
+    try:
+        records = auto_mod.make_records(512)
+        reader = InMemoryReader(records)
+        with start_master(
+            training_shards=reader.create_shards(),
+            records_per_task=128,
+            num_epochs=14,
+        ) as m:
+            trainer = ParameterServerTrainer(
+                spec.build_model(),
+                spec.loss,
+                spec.build_optimizer_spec(),
+                PSClient(addrs),
+                embedding_threshold_bytes=(
+                    auto_mod.embedding_threshold_bytes
+                ),
+            )
+            from elasticdl_tpu.common.constants import JobType
+            from elasticdl_tpu.worker.master_client import MasterClient
+            from elasticdl_tpu.worker.worker import Worker
+
+            worker = Worker(
+                0,
+                MasterClient(m["addr"], 0),
+                reader,
+                spec,
+                trainer,
+                minibatch_size=32,
+                job_type=JobType.TRAINING_ONLY,
+            )
+            eval_records = auto_mod.make_records(128, seed=9)
+            feats, labels = auto_mod.feed(eval_records, "evaluation", None)
+            trainer.init_variables_if_needed(feats)
+            # The swap happened: PS owns the item table, params don't.
+            assert "item_emb" in trainer._embedding_dims
+            out0 = trainer.evaluate_minibatch(feats)
+            loss0 = float(np.mean((out0.reshape(-1) - labels) ** 2))
+            worker.run()
+            assert m["task_d"].finished() and not m["task_d"].job_failed
+            out1 = trainer.evaluate_minibatch(feats)
+            loss1 = float(np.mean((out1.reshape(-1) - labels) ** 2))
+            assert loss1 < loss0 / 5, (loss0, loss1)
+
+            # Reverse swap: export loads into the STOCK model and predicts
+            # as well as the PS-backed trainer did.
+            exported = trainer.export_variables()
+            params = exported["variables"]["params"]
+            assert params["item_emb"]["embedding"].shape == (
+                auto_mod.VOCAB,
+                auto_mod.EMB_DIM,
+            )
+            plain = auto_mod.custom_model()
+            out2 = plain.apply(
+                {"params": params}, feats, training=False
+            )
+            loss2 = float(
+                np.mean((np.asarray(out2).reshape(-1) - labels) ** 2)
+            )
+            assert loss2 < loss0 / 5, (loss0, loss2)
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_pull_embedding_table_paged():
+    """Whole-table export pulls page correctly (tiny pages force the
+    multi-page path) and shared-table double application is refused."""
+    from elasticdl_tpu.ops import optimizers
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    server = ParameterServer(
+        0, 1, optimizer_spec=optimizers.sgd(0.1)
+    )
+    try:
+        client = PSClient([server.addr])
+        infos = [
+            pb.EmbeddingTableInfo(
+                name="t", dim=4, initializer="uniform",
+                dtype=pb.DT_FLOAT32,
+            )
+        ]
+        client.push_model({"w": np.zeros(1, np.float32)}, infos)
+        ids = np.arange(100, dtype=np.int64)
+        rows = client.pull_embedding_vectors("t", ids)
+        # Page size 3 rows: forces 34 pages.
+        got_ids, got_values = client.pull_embedding_table(
+            "t", page_bytes=3 * 4 * 4
+        )
+        order = np.argsort(got_ids)
+        np.testing.assert_array_equal(got_ids[order], ids)
+        np.testing.assert_allclose(got_values[order], rows)
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_shared_table_double_application_refused():
+    class Shared(nn.Module):
+        @nn.compact
+        def __call__(self, features, training=False):
+            emb = nn.Embed(num_embeddings=50, features=4, name="shared")
+            a = emb(features["ids"])
+            b = emb(features["ids"] % 7)
+            return (a + b).sum(axis=-2) @ jnp.ones((4, 1))
+
+    model = wrap_model_for_ps(Shared(), threshold_bytes=16)
+    feats, _ = _sample_features()
+    import pytest
+
+    with pytest.raises(ValueError, match="more than once per forward"):
+        model.init(
+            {"params": jax.random.PRNGKey(0)}, feats, training=False
+        )

@@ -19,6 +19,9 @@ class _EchoPserver:
     def push_embedding_table_infos(self, request, context):
         return pb.Empty()
 
+    def pull_embedding_table(self, request, context):
+        return pb.IndexedSlices()
+
     def pull_dense_parameters(self, request, context):
         return pb.PullDenseParametersResponse(
             initialized=True,
