@@ -128,9 +128,53 @@ def bench_deepfm_criteo(batch_size=8192, steps=30, warmup=5):
     }
 
 
+def bench_elastic_rejoin():
+    """The third north-star metric (BASELINE.json): seconds for a job that
+    loses a worker to SIGKILL to have its replacement back in the job
+    (detection + task recovery + relaunch + re-init + first RPC).
+    Runs the real CLI cluster on the CPU platform so it never contends
+    with the TPU benchmarks; rejoin time is control-plane latency."""
+    import subprocess
+    import sys
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        sys.path.insert(0, os.path.join(repo, "tools"))
+        sys.path.insert(0, os.path.join(repo, "tests"))
+        import test_module
+        from elastic_drill import run_drill
+
+        from elasticdl_tpu.data.recordfile import RecordFileWriter
+
+        with tempfile.TemporaryDirectory() as d:
+            data = os.path.join(d, "linear.edlr")
+            with RecordFileWriter(data) as w:
+                for r in test_module.make_linear_records(256):
+                    w.write(r)
+            result = run_drill(
+                data,
+                model_zoo=os.path.join(repo, "tests"),
+                model_def="test_module",
+                num_workers=2,
+                num_ps=1,
+                num_epochs=300,
+                env_overrides={"JAX_PLATFORMS": "cpu"},
+                timeout=600,
+            )
+        return {
+            "rejoin_s": result.get("rejoin_s"),
+            "completed": result.get("completed"),
+            "relaunched": result.get("relaunched"),
+        }
+    except Exception as e:  # never let the drill sink the whole bench
+        return {"rejoin_s": None, "error": str(e)[:200]}
+
+
 def main():
     resnet = bench_resnet50()
     deepfm = bench_deepfm_criteo()
+    elastic = bench_elastic_rejoin()
     # LocalTrainer's jitted step runs on exactly one device, so its
     # examples/sec IS the per-chip figure regardless of how many chips the
     # host exposes.
@@ -142,6 +186,7 @@ def main():
         "deepfm_examples_per_sec_chip": round(
             deepfm["examples_per_sec"], 2
         ),
+        "elastic_rejoin": elastic,
         "device_kind": jax.devices()[0].device_kind,
         "n_devices": max(jax.local_device_count(), 1),
     }

@@ -88,9 +88,14 @@ def _table_name(module):
 def _oversized(module, threshold_bytes):
     if not isinstance(module, nn.Embed):
         return False
-    bytes_ = module.num_embeddings * module.features * np.dtype(
-        module.dtype or jnp.float32
-    ).itemsize
+    # Size by the STORAGE dtype (param_dtype): under mixed precision the
+    # table lives in float32 while `dtype` is only the compute dtype.
+    storage = getattr(module, "param_dtype", None) or jnp.float32
+    bytes_ = (
+        module.num_embeddings
+        * module.features
+        * np.dtype(storage).itemsize
+    )
     return bytes_ > threshold_bytes
 
 
@@ -245,8 +250,13 @@ def _match_leaf(ids, leaf):
     if ids.size == leaf.size and np.array_equal(
         ids.reshape(-1), leaf.reshape(-1)
     ):
-        shape_tail = ids.shape[1:]
-        return lambda a, t=shape_tail: a.reshape((a.shape[0],) + t)
+        if ids.ndim >= 1 and ids.shape[0] == leaf.shape[0]:
+            # Batch-preserving reshape ([B, F] -> [B, ...]).
+            shape_tail = ids.shape[1:]
+            return lambda a, t=shape_tail: a.reshape((a.shape[0],) + t)
+        if ids.ndim == 1:
+            # Full flatten ([B, F] -> [B*F]).
+            return lambda a: a.reshape(-1)
     return None
 
 
@@ -320,6 +330,19 @@ def stuff_export_params(params, ps_tables, default_vocab=None):
             table, int(ids.max()) + 1 if ids.size else 0
         )
         full = np.zeros((vocab, values.shape[1]), values.dtype)
+        in_range = ids < vocab
+        if not in_range.all():
+            # Dirty data can materialize PS rows beyond the declared
+            # vocab (training's clamped gather tolerates it); the export
+            # must keep the stock model's declared shape, so drop them.
+            logger.warning(
+                "Table %s: dropping %d rows with ids >= declared vocab "
+                "%d at export",
+                table,
+                int((~in_range).sum()),
+                vocab,
+            )
+            ids, values = ids[in_range], values[in_range]
         full[ids] = values
         node = params
         parts = table.split("/")

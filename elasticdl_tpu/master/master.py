@@ -311,18 +311,23 @@ class Master:
         last_watchdog = time.time()
         last_metrics = time.time()
         last_records = self.task_d.stats()["records_done"]
+        # Brief linger before the server stops on ANY terminal path, so
+        # monitors polling get_job_status can observe the terminal state
+        # (finished OR failed) instead of an ambiguous UNAVAILABLE.
+        def linger():
+            time.sleep(
+                getattr(self.args, "shutdown_linger_seconds", 2.0)
+            )
+
         try:
             while True:
                 if self.task_d.finished():
                     logger.info("All tasks complete; job done")
-                    # Brief linger so monitors polling get_job_status can
-                    # observe the terminal state before the server stops.
-                    time.sleep(
-                        getattr(self.args, "shutdown_linger_seconds", 2.0)
-                    )
+                    linger()
                     return 1 if self.task_d.job_failed else 0
                 if self.task_d.job_failed:
                     logger.error("Job failed (task retries exhausted)")
+                    linger()
                     return 1
                 if self.instance_manager is not None:
                     if self.instance_manager.all_workers_failed():

@@ -130,6 +130,10 @@ class MasterServicer:
                 for ts in self.worker_liveness.values()
                 if now - ts < self._worker_liveness_timeout
             )
+            last_seen_ago = {
+                wid: now - ts
+                for wid, ts in self.worker_liveness.items()
+            }
             version = self.max_model_version
         res = pb.JobStatusResponse(
             todo_tasks=stats["todo"],
@@ -142,6 +146,8 @@ class MasterServicer:
             job_failed=stats["job_failed"],
             records_done=stats["records_done"],
         )
+        for wid, age in last_seen_ago.items():
+            res.worker_last_seen_ago[wid] = age
         if (
             self._evaluation_service is not None
             and self._evaluation_service.completed_results

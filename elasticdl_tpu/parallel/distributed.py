@@ -17,6 +17,18 @@ from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("parallel.distributed")
 
+
+def _clear_backends():
+    try:
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+    except Exception:
+        logger.warning(
+            "could not clear XLA backends after leaving world",
+            exc_info=True,
+        )
+
 _current = {
     "coordinator": None,
     "world": 0,
@@ -47,6 +59,13 @@ def ensure_world(coordinator_addr, world_size, rank, epoch=None):
         logger.info("Leaving distributed world %s", _current)
         jax.distributed.shutdown()
         _current["live"] = False
+        # The XLA backend caches the old world's device topology, and
+        # jax.distributed.initialize refuses to run once a backend is
+        # initialized — drop the cached backends so the re-init (elastic
+        # regroup) can rebuild the device set. Compiled functions from the
+        # old world are invalid either way; trainers rebuild their jitted
+        # steps after a regroup.
+        _clear_backends()
     if world_size <= 1:
         _current.update(coordinator=None, world=1, rank=0, epoch=epoch)
         return

@@ -145,14 +145,20 @@ class PSClient:
             out[positions] = values
         return out
 
-    def pull_embedding_table(self, name, page_bytes=64 << 20):
+    def pull_embedding_table(self, name, page_bytes=64 << 20, dim=None):
         """Every materialized (id, row) of a table, merged across shards —
         the export reverse-swap. Pulled in pages so a CTR-scale table
-        never has to fit one gRPC message (256 MB cap). Returns
-        (ids [n], values [n, dim]); (empty, None) if no rows exist."""
+        never has to fit one gRPC message (256 MB cap); pass `dim` so the
+        FIRST page is bounded too (wide tables would otherwise blow the
+        cap before the row size is known). Returns (ids [n],
+        values [n, dim]); (empty, None) if no rows exist."""
+        if dim:
+            first_page = max(1, page_bytes // (int(dim) * 4))
+        else:
+            first_page = 65536
         all_ids, all_values = [], []
         for stub in self._stubs:
-            start, requested = 0, 65536  # re-sized once dim is known
+            start, requested = 0, first_page
             while True:
                 res = stub.pull_embedding_table(
                     pb.PullEmbeddingTableRequest(

@@ -112,12 +112,18 @@ class ParameterServerTrainer(JaxTrainer):
             self._embedding_paths[table] = path
         if auto and not self._embedding_dims:
             # Nothing swapped and no DistributedEmbedding layers: drop the
-            # wrapper and re-init so param names stay unprefixed.
+            # wrapper. It added exactly one 'inner' nesting level and no
+            # params of its own, so stripping that level (instead of a
+            # second full init/trace) restores the unprefixed tree.
             self._model = self._inner_model
             self._inner_model = None
-            self._variables = None
-            super().init_variables_if_needed(features)
-            self._variables.pop(EMBEDDING_COLLECTION, None)
+            self._variables = {
+                k: (v["inner"] if hasattr(v, "keys") and "inner" in v else v)
+                for k, v in self._variables.items()
+            }
+            self._opt_state = self._optax.init(self._variables["params"])
+            self._train_step = self._build_train_step()
+            self._forward = self._build_forward()
         if self._embedding_dims and self._embedding_inputs is None:
             # Derive the feed the reference's ModelHandler made implicit:
             # capture which ids each table consumed on this first batch
@@ -314,8 +320,10 @@ class ParameterServerTrainer(JaxTrainer):
         if self._inner_model is not None:
             params = params.get("inner", params)
             ps_tables = {}
-            for table in self._embedding_dims:
-                ids, values = self._ps.pull_embedding_table(table)
+            for table, dim in self._embedding_dims.items():
+                ids, values = self._ps.pull_embedding_table(
+                    table, dim=dim
+                )
                 if values is not None:
                     ps_tables[table] = (ids, values)
             from elasticdl_tpu.common.model_handler import (

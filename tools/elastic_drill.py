@@ -1,0 +1,206 @@
+"""Elasticity drill: kill a worker mid-job, measure the rejoin.
+
+The BASELINE third north-star metric is elastic rejoin time — how long a
+job takes to resume making progress after losing a worker (the reference's
+headline capability, benchmarked in docs/benchmark/report_cn.md:66-96 as
+elastic-vs-gang job time). This drill:
+
+1. starts a REAL `edl train` job (local_process backend) as a subprocess,
+2. polls the master's get_job_status RPC until training progresses,
+3. SIGKILLs one worker process mid-epoch,
+4. measures t(kill) -> t(records_done advances again with the worker back)
+   — the rejoin time: detection + task recovery + relaunch + re-init,
+5. waits for the job to finish and reports JSON on stdout.
+
+Usable standalone (`python tools/elastic_drill.py`), from the e2e test,
+and from bench.py (which folds rejoin_s into the benchmark details).
+"""
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _find_worker_pid(worker_id, master_port, timeout=60):
+    """Pid of the worker subprocess (a python -m elasticdl_tpu.worker.main
+    child with our master port on its command line)."""
+    needle = f"--master_addr 127.0.0.1:{master_port}"
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        out = subprocess.run(
+            ["pgrep", "-af", "elasticdl_tpu.worker.main"],
+            capture_output=True,
+            text=True,
+        ).stdout
+        for line in out.splitlines():
+            if needle in line and f"--worker_id {worker_id}" in line:
+                return int(line.split()[0])
+        time.sleep(0.2)
+    raise RuntimeError(f"worker {worker_id} process not found")
+
+
+def run_drill(
+    data_path,
+    model_zoo,
+    model_def,
+    num_workers=2,
+    num_ps=1,
+    num_epochs=8,
+    minibatch_size=32,
+    records_per_task=64,
+    extra_args=(),
+    env_overrides=None,
+    timeout=300,
+):
+    import grpc
+
+    from elasticdl_tpu.common import rpc
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        f"{REPO}:{model_zoo}:" + env.get("PYTHONPATH", "")
+    )
+    env.update(env_overrides or {})
+    train = subprocess.Popen(
+        [
+            sys.executable, "-m", "elasticdl_tpu.client.main", "train",
+            "--model_zoo", model_zoo,
+            "--model_def", model_def,
+            "--training_data", data_path,
+            "--num_epochs", str(num_epochs),
+            "--records_per_task", str(records_per_task),
+            "--minibatch_size", str(minibatch_size),
+            "--num_workers", str(num_workers),
+            "--num_ps", str(num_ps),
+            "--distribution_strategy",
+            "ParameterServerStrategy" if num_ps else "Local",
+            "--instance_backend", "local_process",
+            "--master_port", str(port),
+            *extra_args,
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+        cwd=REPO,
+    )
+    result = {
+        "completed": False,
+        "killed_worker": None,
+        "rejoin_s": None,
+        "records_at_kill": None,
+        "records_done": None,
+    }
+    try:
+        stub = rpc.Stub(
+            rpc.build_channel(f"127.0.0.1:{port}"), rpc.MASTER_SERVICE
+        )
+
+        def status(deadline):
+            while time.time() < deadline:
+                try:
+                    return stub.get_job_status(pb.GetJobStatusRequest())
+                except grpc.RpcError:
+                    if train.poll() is not None:
+                        return None
+                    time.sleep(0.2)
+            return None
+
+        # Wait until training actually progresses.
+        deadline = time.time() + timeout
+        while True:
+            s = status(deadline)
+            if s is None:
+                raise RuntimeError("job never started making progress")
+            if s.records_done > 0 and s.alive_workers >= num_workers:
+                break
+            time.sleep(0.2)
+
+        # The drill: SIGKILL worker 0 (preemption).
+        victim = _find_worker_pid(0, port)
+        os.kill(victim, signal.SIGKILL)
+        t_kill = time.time()
+        result["killed_worker"] = victim
+        result["records_at_kill"] = int(s.records_done)
+
+        # Rejoin = the REPLACEMENT worker back in the job: a new worker-0
+        # process exists (detection + relaunch) and worker 0's last-seen
+        # age shows an RPC made AFTER the relaunch (its re-init + first
+        # task pull) — attributed per worker, so survivors' concurrent
+        # progress can't fake it.
+        try:
+            replacement = victim
+            while replacement == victim:
+                replacement = _find_worker_pid(0, port, timeout=60)
+                time.sleep(0.1)
+            result["replacement_worker"] = replacement
+            t_relaunch = time.time()
+            while True:
+                s = status(time.time() + 30)
+                if s is None or s.finished:
+                    break
+                age = dict(s.worker_last_seen_ago).get(0)
+                if age is not None and time.time() - age >= t_relaunch:
+                    result["rejoin_s"] = round(time.time() - t_kill, 3)
+                    break
+                time.sleep(0.1)
+        except RuntimeError:
+            pass  # job drained before the relaunch was observed
+
+        train.wait(timeout=timeout)
+        result["completed"] = train.returncode == 0
+        out = train.stdout.read()
+        result["relaunched"] = "Relaunching worker 0" in out
+        result["recovered_tasks"] = "Recovered" in out
+        result["log_tail"] = out[-2000:]
+        # Final record count from the log is not available post-shutdown;
+        # report the last sampled figure.
+        if s is not None:
+            result["records_done"] = int(s.records_done)
+        return result
+    finally:
+        if train.poll() is None:
+            train.kill()
+
+
+def main():
+    p = argparse.ArgumentParser("elastic_drill")
+    p.add_argument("--training_data", required=True)
+    p.add_argument("--model_zoo", default=os.path.join(REPO, "tests"))
+    p.add_argument("--model_def", default="test_module")
+    p.add_argument("--num_workers", type=int, default=2)
+    p.add_argument("--num_ps", type=int, default=1)
+    p.add_argument("--num_epochs", type=int, default=8)
+    args = p.parse_args()
+    result = run_drill(
+        args.training_data,
+        args.model_zoo,
+        args.model_def,
+        num_workers=args.num_workers,
+        num_ps=args.num_ps,
+        num_epochs=args.num_epochs,
+    )
+    result.pop("log_tail", None)
+    print(json.dumps(result))
+    return 0 if result["completed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
