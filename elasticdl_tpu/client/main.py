@@ -80,6 +80,34 @@ def _master_pod_manifest(job_args, raw_argv):
     command = ["python", "-m", "elasticdl_tpu.master.main"] + _strip_flag(
         raw_argv, "--yaml"
     )
+    # The master reads the training data itself (shard creation), so it
+    # needs the same --volume mounts the worker/PS pods get.
+    volumes, mounts, by_source = [], [], {}
+    from elasticdl_tpu.common.k8s_resource import parse_volume_spec
+
+    for vd in parse_volume_spec(getattr(job_args, "volume", "")):
+        key = (vd["kind"], vd["source"])
+        name = by_source.get(key)
+        if name is None:
+            name = f"edl-vol-{len(volumes)}"
+            by_source[key] = name
+            if vd["kind"] == "pvc":
+                volumes.append(
+                    {
+                        "name": name,
+                        "persistentVolumeClaim": {
+                            "claimName": vd["source"]
+                        },
+                    }
+                )
+            else:
+                volumes.append(
+                    {"name": name, "hostPath": {"path": vd["source"]}}
+                )
+        mount = {"name": name, "mountPath": vd["mount_path"]}
+        if "sub_path" in vd:
+            mount["subPath"] = vd["sub_path"]
+        mounts.append(mount)
     return {
         "apiVersion": "v1",
         "kind": "Pod",
@@ -94,11 +122,15 @@ def _master_pod_manifest(job_args, raw_argv):
         "spec": {
             "serviceAccountName": "elasticdl-master",
             "restartPolicy": "Never",
+            **({"volumes": volumes} if volumes else {}),
             "containers": [
                 {
                     "name": "master",
                     "image": job_args.image_name,
                     "command": command,
+                    **(
+                        {"volumeMounts": mounts} if mounts else {}
+                    ),
                     "env": [
                         {
                             "name": "MY_POD_IP",
@@ -224,6 +256,31 @@ def _zoo_build(args):
     return 0
 
 
+def _zoo_push(args):
+    """Push a built model-zoo image to its registry (reference
+    elasticdl_client/api.py:93-113 pushes via the docker SDK). Shells out
+    to the docker CLI when present; otherwise prints the command so
+    air-gapped environments can run it where docker lives."""
+    import shutil as _shutil
+    import subprocess
+
+    cmd = ["docker", "push", args.image]
+    if args.dry_run:
+        print(" ".join(cmd))
+        return 0
+    if _shutil.which("docker") is None:
+        # Without docker this command cannot do its job — failing loudly
+        # keeps CI from submitting jobs whose image never shipped.
+        print(" ".join(cmd))
+        logger.error(
+            "docker CLI not found; run the printed command where docker "
+            "is available (or use --dry_run to silence this error)"
+        )
+        return 1
+    res = subprocess.run(cmd)
+    return res.returncode
+
+
 def _top(args):
     """Live job monitor: poll the master's job-status RPC and print one
     status line per interval (the in-job analog of the reference's
@@ -344,6 +401,14 @@ def main(argv=None):
             "--base_image", default="python:3.12-slim"
         )
         build_p.set_defaults(func=_zoo_build)
+        push_p = sub.add_parser("push")
+        push_p.add_argument("--image", required=True)
+        push_p.add_argument(
+            "--dry_run",
+            action="store_true",
+            help="print the push command instead of running it",
+        )
+        push_p.set_defaults(func=_zoo_push)
         zargs = zoo.parse_args(rest)
         return zargs.func(zargs)
 

@@ -89,8 +89,24 @@ def add_cluster_arguments(parser):
     )
     parser.add_argument("--namespace", default="default")
     parser.add_argument("--image_name", default="")
-    parser.add_argument("--worker_resources", default="")
+    parser.add_argument(
+        "--worker_resources",
+        default="",
+        help="per-worker pod resources, e.g. cpu=4,memory=8Gi,tpu=4",
+    )
     parser.add_argument("--ps_resources", default="")
+    parser.add_argument(
+        "--worker_pod_priority",
+        default="",
+        help="priority class for worker pods; 'high=0.5' gives the first "
+        "half the 'high' class and the rest 'low'",
+    )
+    parser.add_argument(
+        "--volume",
+        default="",
+        help="pod volumes: host_path=/d,mount_path=/d;"
+        "claim_name=c,mount_path=/m[,sub_path=s]",
+    )
     parser.add_argument("--max_relaunches", type=int, default=3)
     parser.add_argument("--master_port", type=int, default=50001)
     parser.add_argument(

@@ -27,6 +27,50 @@ ELASTICDL_REPLICA_TYPE_KEY = "elasticdl-replica-type"
 ELASTICDL_REPLICA_INDEX_KEY = "elasticdl-replica-index"
 
 
+def build_volumes(volume_dicts):
+    """Parsed volume dicts (common/k8s_resource.parse_volume_spec) ->
+    (V1Volume list, V1VolumeMount list). Mounts sharing a source share one
+    volume (the reference dedupes the same way, k8s_volume.py:47-81)."""
+    if not volume_dicts:
+        return [], []
+    require_k8s()
+    volumes, mounts, by_source = [], [], {}
+    for i, vd in enumerate(volume_dicts):
+        key = (vd["kind"], vd["source"])
+        name = by_source.get(key)
+        if name is None:
+            name = f"edl-vol-{len(volumes)}"
+            by_source[key] = name
+            if vd["kind"] == "pvc":
+                volumes.append(
+                    k8s_api.V1Volume(
+                        name=name,
+                        persistent_volume_claim=(
+                            k8s_api.V1PersistentVolumeClaimVolumeSource(
+                                claim_name=vd["source"], read_only=False
+                            )
+                        ),
+                    )
+                )
+            else:
+                volumes.append(
+                    k8s_api.V1Volume(
+                        name=name,
+                        host_path=k8s_api.V1HostPathVolumeSource(
+                            path=vd["source"]
+                        ),
+                    )
+                )
+        mounts.append(
+            k8s_api.V1VolumeMount(
+                name=name,
+                mount_path=vd["mount_path"],
+                sub_path=vd.get("sub_path"),
+            )
+        )
+    return volumes, mounts
+
+
 def require_k8s():
     if not K8S_AVAILABLE:
         raise RuntimeError(
@@ -82,6 +126,7 @@ class Client:  # pragma: no cover - exercised only on a real cluster
         resource_limits=None,
         priority_class=None,
         envs=None,
+        volumes=None,
         restart_policy="Never",
     ):
         env = [
@@ -100,6 +145,7 @@ class Client:  # pragma: no cover - exercised only on a real cluster
                 ),
             )
         )
+        pod_volumes, mounts = build_volumes(volumes or [])
         container = k8s_api.V1Container(
             name="main",
             image=self.image_name,
@@ -108,6 +154,7 @@ class Client:  # pragma: no cover - exercised only on a real cluster
                 requests=resource_requests, limits=resource_limits
             ),
             env=env,
+            volume_mounts=mounts or None,
         )
         pod = k8s_api.V1Pod(
             metadata=k8s_api.V1ObjectMeta(
@@ -122,6 +169,7 @@ class Client:  # pragma: no cover - exercised only on a real cluster
                 containers=[container],
                 restart_policy=restart_policy,
                 priority_class_name=priority_class,
+                volumes=pod_volumes or None,
             ),
         )
         return self._v1.create_namespaced_pod(self.namespace, pod)

@@ -42,8 +42,26 @@ def pb_dtype_to_np(dtype_enum: int) -> np.dtype:
         raise ValueError(f"unknown wire dtype enum: {dtype_enum}")
 
 
+def _is_string_array(arr):
+    return arr.dtype.kind in ("U", "S", "O", "T")
+
+
 def ndarray_to_tensor_pb(arr: np.ndarray, name: str = "") -> pb.Tensor:
     arr = np.asarray(arr)  # not ascontiguousarray: that promotes 0-d to 1-d
+    if _is_string_array(arr):
+        # Variable-length strings: concatenated UTF-8 bytes + per-element
+        # lengths (the reference carries these as TF bytes features).
+        encoded = [
+            s if isinstance(s, bytes) else str(s).encode("utf-8")
+            for s in arr.reshape(-1)
+        ]
+        return pb.Tensor(
+            name=name,
+            dims=list(arr.shape),
+            dtype=pb.DT_STRING,
+            content=b"".join(encoded),
+            string_lengths=[len(e) for e in encoded],
+        )
     return pb.Tensor(
         name=name,
         dims=list(arr.shape),
@@ -53,6 +71,19 @@ def ndarray_to_tensor_pb(arr: np.ndarray, name: str = "") -> pb.Tensor:
 
 
 def tensor_pb_to_ndarray(tensor_pb: pb.Tensor) -> np.ndarray:
+    if tensor_pb.dtype == pb.DT_STRING:
+        parts, offset = [], 0
+        for length in tensor_pb.string_lengths:
+            raw = tensor_pb.content[offset:offset + length]
+            try:
+                parts.append(raw.decode("utf-8"))
+            except UnicodeDecodeError:
+                # Binary bytes features round-trip as bytes.
+                parts.append(raw)
+            offset += length
+        return np.asarray(parts, dtype=object).reshape(
+            tuple(tensor_pb.dims)
+        )
     dtype = pb_dtype_to_np(tensor_pb.dtype)
     arr = np.frombuffer(tensor_pb.content, dtype=dtype)
     return arr.reshape(tuple(tensor_pb.dims)).copy()

@@ -33,19 +33,32 @@ class K8sInstanceManager:
         worker_resources=None,
         ps_resources=None,
         worker_priority=None,
+        volumes=None,
         max_relaunches=DEFAULT_MAX_RELAUNCHES,
         envs=None,
         ps_service_port=50002,
     ):
         k8s_client.require_k8s()
+        from elasticdl_tpu.common.k8s_resource import (
+            parse_resource_spec,
+            parse_volume_spec,
+            parse_worker_priority,
+        )
+
         self._command_for = command_for
         self._num_workers = num_workers
         self._num_ps = num_ps
         self._task_d = task_dispatcher
         self._membership = membership
-        self._worker_resources = worker_resources
-        self._ps_resources = ps_resources
-        self._worker_priority = worker_priority
+        # Spec strings parse here ("cpu=4,memory=8Gi,tpu=4", "high=0.5",
+        # "host_path=/data,mount_path=/data") so a bad spec fails the job
+        # at submission, not at the first relaunch.
+        self._worker_resources = parse_resource_spec(worker_resources)
+        self._ps_resources = parse_resource_spec(ps_resources)
+        self._worker_priority = parse_worker_priority(
+            worker_priority, num_workers
+        )
+        self._volumes = parse_volume_spec(volumes)
         self._max_relaunches = max_relaunches
         self._envs = envs or {}
         self._ps_service_port = ps_service_port
@@ -68,17 +81,29 @@ class K8sInstanceManager:
             self._start("worker", worker_id)
 
     def _start(self, kind, instance_id):
+        resources = (
+            self._ps_resources if kind == "ps" else self._worker_resources
+        )
+        # cpu/memory stay requests-only (a limit would turn a scheduling
+        # hint into a throttle/OOM boundary); extended device resources
+        # (nvidia.com/gpu, google.com/tpu) MUST appear in limits — the
+        # device plugin API requires it.
+        device_limits = {
+            k: v for k, v in (resources or {}).items() if "/" in k
+        }
         self._client.create_pod(
             kind,
             instance_id,
             self._command_for(kind, instance_id),
-            resource_requests=(
-                self._ps_resources if kind == "ps" else self._worker_resources
-            ),
+            resource_requests=resources or None,
+            resource_limits=device_limits or None,
             priority_class=(
-                self._worker_priority if kind == "worker" else None
+                self._worker_priority.get(instance_id)
+                if kind == "worker"
+                else None
             ),
             envs=self._envs,
+            volumes=self._volumes,
         )
         if kind == "ps":
             # Stable service name so a relaunched PS keeps its address and

@@ -189,6 +189,10 @@ class Master:
                 num_ps=args.num_ps,
                 task_dispatcher=self.task_d,
                 membership=self.membership,
+                worker_resources=args.worker_resources,
+                ps_resources=args.ps_resources,
+                worker_priority=args.worker_pod_priority,
+                volumes=args.volume,
                 max_relaunches=args.max_relaunches,
             )
         raise ValueError(f"unknown backend {args.instance_backend!r}")

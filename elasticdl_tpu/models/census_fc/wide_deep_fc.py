@@ -1,0 +1,188 @@
+"""Census wide & deep declared entirely as feature columns.
+
+Reference counterpart: /root/reference/model_zoo/census_model_sqlflow/
+wide_and_deep/ — the SQLFlow-generated census model whose feature handling
+is a declarative transform graph (vocab lookups, bucketize, hash, embed)
+parameterized by analyzer statistics. Here the same shape is expressed
+with elasticdl_tpu.preprocessing.feature_column specs, with boundaries and
+vocabularies overridable through the analyzer env contract
+(preprocessing/analyzer_utils.py) exactly as an external analysis job
+would publish them.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.evaluation_utils import MeanMetric
+from elasticdl_tpu.common.model_utils import Modes
+from elasticdl_tpu.data.example import batch_examples, encode_example
+from elasticdl_tpu.ops import optimizers
+from elasticdl_tpu.preprocessing import analyzer_utils
+from elasticdl_tpu.preprocessing import feature_column as fc
+
+WORKCLASS_VOCAB = [
+    "Private",
+    "Self-emp-not-inc",
+    "Self-emp-inc",
+    "Federal-gov",
+    "Local-gov",
+    "State-gov",
+    "Without-pay",
+    "Never-worked",
+]
+EDUCATION_VOCAB = [
+    "Bachelors",
+    "HS-grad",
+    "11th",
+    "Masters",
+    "9th",
+    "Some-college",
+    "Assoc-acdm",
+    "Assoc-voc",
+    "Doctorate",
+    "Prof-school",
+]
+
+
+def build_columns():
+    """Analyzer-statistics-driven column specs (env-overridable)."""
+    age_boundaries = analyzer_utils.get_bucket_boundaries(
+        "age", [25, 35, 45, 55, 65]
+    )
+    hours_boundaries = analyzer_utils.get_bucket_boundaries(
+        "hours", [20, 35, 45]
+    )
+    workclass = fc.categorical_column_with_vocabulary_list(
+        "workclass", analyzer_utils.get_vocabulary(
+            "workclass", WORKCLASS_VOCAB
+        )
+    )
+    education = fc.categorical_column_with_vocabulary_list(
+        "education", analyzer_utils.get_vocabulary(
+            "education", EDUCATION_VOCAB
+        )
+    )
+    occupation = fc.categorical_column_with_hash_bucket("occupation", 50)
+    age_bucket = fc.bucketized_column("age", age_boundaries)
+    hours_bucket = fc.bucketized_column("hours", hours_boundaries)
+
+    wide = tuple(
+        fc.indicator_column(cat)
+        for cat in (workclass, education, occupation, age_bucket,
+                    hours_bucket)
+    )
+    deep = (
+        fc.embedding_column(workclass, 8),
+        fc.embedding_column(education, 8),
+        fc.embedding_column(occupation, 8),
+        fc.embedding_column(age_bucket, 8),
+        fc.embedding_column(hours_bucket, 8),
+        fc.numeric_column(
+            "age",
+            normalizer_fn=lambda x: (
+                x - analyzer_utils.get_avg("age", 38.0)
+            ) / analyzer_utils.get_stddev("age", 13.0),
+        ),
+        fc.numeric_column(
+            "hours",
+            normalizer_fn=lambda x: (
+                x - analyzer_utils.get_avg("hours", 40.0)
+            ) / analyzer_utils.get_stddev("hours", 12.0),
+        ),
+    )
+    return wide, deep
+
+
+class WideDeepFC(nn.Module):
+    wide_columns: tuple
+    deep_columns: tuple
+
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        wide = fc.DenseFeatures(self.wide_columns, name="wide")(features)
+        deep = fc.DenseFeatures(self.deep_columns, name="deep")(features)
+        for width in (32, 16):
+            deep = nn.relu(nn.Dense(width)(deep))
+        logit = nn.Dense(1)(jnp.concatenate([wide, deep], axis=-1))
+        return logit.reshape(-1)
+
+
+_WIDE, _DEEP = None, None
+
+
+def _columns():
+    global _WIDE, _DEEP
+    if _WIDE is None:
+        _WIDE, _DEEP = build_columns()
+    return _WIDE, _DEEP
+
+
+def custom_model():
+    wide, deep = _columns()
+    return WideDeepFC(wide, deep)
+
+
+def loss(labels, logits):
+    return jnp.mean(
+        optax.sigmoid_binary_cross_entropy(
+            logits.reshape(-1), labels.reshape(-1).astype(jnp.float32)
+        )
+    )
+
+
+def optimizer(lr=0.01):
+    return optimizers.adam(learning_rate=lr)
+
+
+def feed(records, mode, metadata):
+    batch = batch_examples(records)
+    wide, deep = _columns()
+    # Host-side pass: hash/vocab string columns become int ids; the model
+    # sees only numbers (required under jit).
+    features = fc.DenseFeatures(wide + deep).preprocess(batch)
+    labels = (
+        batch["label"].astype(np.float32)
+        if mode != Modes.PREDICTION
+        else None
+    )
+    features.pop("label", None)
+    return features, labels
+
+
+def eval_metrics_fn():
+    def correct(outputs, labels):
+        preds = (np.asarray(outputs).reshape(-1) > 0).astype(np.float32)
+        return (preds == np.asarray(labels).reshape(-1)).astype(
+            np.float32
+        )
+
+    return {"accuracy": MeanMetric(correct)}
+
+
+def make_records(n, seed=0):
+    """Synthetic census-like rows with a learnable relationship."""
+    rng = np.random.default_rng(seed)
+    w_work = rng.normal(size=len(WORKCLASS_VOCAB) + 1)
+    w_edu = rng.normal(size=len(EDUCATION_VOCAB) + 1)
+    records = []
+    for _ in range(n):
+        wi = int(rng.integers(0, len(WORKCLASS_VOCAB)))
+        ei = int(rng.integers(0, len(EDUCATION_VOCAB)))
+        age = float(rng.uniform(18, 80))
+        hours = float(rng.uniform(5, 60))
+        score = w_work[wi] + w_edu[ei] + 0.03 * (age - 45)
+        records.append(
+            encode_example(
+                {
+                    "workclass": WORKCLASS_VOCAB[wi],
+                    "education": EDUCATION_VOCAB[ei],
+                    "occupation": f"occ{int(rng.integers(0, 30))}",
+                    "age": np.float32(age),
+                    "hours": np.float32(hours),
+                    "label": np.int64(score > 0),
+                }
+            )
+        )
+    return records

@@ -1,0 +1,111 @@
+"""WDL (wide & deep learning) declared deepctr-style via feature specs.
+
+Reference counterpart: /root/reference/model_zoo/deepctr/wdl.py — the
+deepctr-library zoo entry builds its model from SparseFeat/DenseFeat specs
+(hash buckets over the 26 Criteo categoricals, 13 numeric features) and
+lets the library assemble WDL. Here the same declarative shape uses
+elasticdl_tpu.preprocessing.feature_column: hashed categorical ->
+embedding columns for the deep tower, indicator-free wide tower as dim-1
+embeddings, numeric columns log-normalized. Embedding tables are stock
+nn.Embed, so the ModelHandler PS-swaps any of them that exceed the size
+threshold under ParameterServerStrategy.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.common.evaluation_utils import AUCMetric
+from elasticdl_tpu.common.model_utils import Modes
+from elasticdl_tpu.data.example import batch_examples
+from elasticdl_tpu.ops import optimizers
+from elasticdl_tpu.preprocessing import feature_column as fc
+
+NUM_DENSE = 13
+NUM_SPARSE = 26
+HASH_BUCKETS = 10000
+EMB_DIM = 4
+
+SPARSE_KEYS = [f"C{i}" for i in range(1, NUM_SPARSE + 1)]
+DENSE_KEYS = [f"I{i}" for i in range(1, NUM_DENSE + 1)]
+
+
+def _log_norm(x):
+    return jnp.log1p(jnp.maximum(x, 0.0))
+
+
+def build_columns():
+    cats = {
+        key: fc.categorical_column_with_hash_bucket(key, HASH_BUCKETS)
+        for key in SPARSE_KEYS
+    }
+    deep = tuple(
+        fc.embedding_column(cats[key], EMB_DIM) for key in SPARSE_KEYS
+    ) + tuple(
+        fc.numeric_column(key, normalizer_fn=_log_norm)
+        for key in DENSE_KEYS
+    )
+    # Wide tower: dim-1 embeddings = a learned weight per hash bucket
+    # (deepctr's linear feature columns).
+    wide = tuple(
+        fc.embedding_column(cats[key], 1) for key in SPARSE_KEYS
+    ) + tuple(
+        fc.numeric_column(key, normalizer_fn=_log_norm)
+        for key in DENSE_KEYS
+    )
+    return wide, deep
+
+
+class WDL(nn.Module):
+    wide_columns: tuple
+    deep_columns: tuple
+    hidden_units: tuple = (128, 64)
+
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        wide = fc.DenseFeatures(self.wide_columns, name="wide")(features)
+        deep = fc.DenseFeatures(self.deep_columns, name="deep")(features)
+        for width in self.hidden_units:
+            deep = nn.relu(nn.Dense(width)(deep))
+        logit = jnp.sum(wide, axis=-1) + nn.Dense(1)(deep).reshape(-1)
+        return logit
+
+
+_WIDE, _DEEP = build_columns()
+
+
+def custom_model():
+    return WDL(_WIDE, _DEEP)
+
+
+def loss(labels, logits):
+    return jnp.mean(
+        optax.sigmoid_binary_cross_entropy(
+            logits.reshape(-1), labels.reshape(-1).astype(jnp.float32)
+        )
+    )
+
+
+def optimizer(lr=0.001):
+    return optimizers.adam(learning_rate=lr)
+
+
+def feed(records, mode, metadata):
+    batch = batch_examples(records)
+    # Raw integer Criteo ids hash in-graph (feature_column._jnp_int_hash);
+    # preprocess only rewrites string-typed columns.
+    features = {
+        key: batch[key] for key in DENSE_KEYS + SPARSE_KEYS
+    }
+    features = fc.DenseFeatures(_WIDE + _DEEP).preprocess(features)
+    labels = (
+        batch["label"].astype(np.float32)
+        if mode != Modes.PREDICTION
+        else None
+    )
+    return features, labels
+
+
+def eval_metrics_fn():
+    return {"auc": AUCMetric()}

@@ -1,0 +1,62 @@
+"""Dataset-statistics lookup from the environment.
+
+Reference counterpart: /root/reference/elasticdl_preprocessing/utils/
+analyzer_utils.py:15-30 + constants.py — an external analysis job (SQLFlow
+in the reference) publishes per-feature statistics as environment variables
+(`_{feature}_min`, `_{feature}_stddev`, ...) and preprocessing layers pick
+them up at model-build time, falling back to defaults for unit tests. The
+env naming is kept verbatim so jobs written against the reference's
+analyzer contract parameterize these layers unchanged.
+"""
+
+import os
+
+_MIN = "_{}_min"
+_MAX = "_{}_max"
+_AVG = "_{}_avg"
+_STDDEV = "_{}_stddev"
+_BUCKET_BOUNDARIES = "_{}_boundaries"
+_DISTINCT_COUNT = "_{}_distinct_count"
+_VOCABULARY = "_{}_vocab"
+
+
+def _float_env(template, feature_name, default_value):
+    value = os.environ.get(template.format(feature_name))
+    return float(value) if value is not None else default_value
+
+
+def get_min(feature_name, default_value):
+    return _float_env(_MIN, feature_name, default_value)
+
+
+def get_max(feature_name, default_value):
+    return _float_env(_MAX, feature_name, default_value)
+
+
+def get_avg(feature_name, default_value):
+    return _float_env(_AVG, feature_name, default_value)
+
+
+def get_stddev(feature_name, default_value):
+    return _float_env(_STDDEV, feature_name, default_value)
+
+
+def get_bucket_boundaries(feature_name, default_value):
+    """Comma-separated floats -> sorted list."""
+    value = os.environ.get(_BUCKET_BOUNDARIES.format(feature_name))
+    if value is None:
+        return default_value
+    return sorted(float(v) for v in value.split(",") if v.strip())
+
+
+def get_distinct_count(feature_name, default_value):
+    value = os.environ.get(_DISTINCT_COUNT.format(feature_name))
+    return int(value) if value is not None else default_value
+
+
+def get_vocabulary(feature_name, default_value):
+    """Comma-separated tokens -> list."""
+    value = os.environ.get(_VOCABULARY.format(feature_name))
+    if value is None:
+        return default_value
+    return [v for v in value.split(",") if v]

@@ -1,0 +1,291 @@
+"""Feature columns: declarative spec -> dense input tensor.
+
+Reference counterparts: the EDL embedding feature column
+(/root/reference/elasticdl/python/elasticdl/feature_column/
+feature_column.py:25-221) and the preprocessing package's embedding_column
+(elasticdl_preprocessing/feature_column/feature_column.py).
+
+TPU-first redesign: columns are plain dataclass specs lowered by ONE flax
+module (`DenseFeatures`) into gathers/one-hots/concats that XLA fuses.
+`embedding_column` lowers to a stock `nn.Embed`, which means the
+ModelHandler (common/model_handler.py) transparently swaps any table over
+the 2 MB threshold to the parameter server under the PS strategy — the
+same "feature columns leverage the PS iff the table is big" behavior the
+reference implements with a custom TF EmbeddingColumn, with zero custom
+lookup code here.
+
+Categorical transforms (hashing, vocab lookup) reuse the preprocessing
+layers; statistics-driven defaults come from analyzer_utils (env vars).
+"""
+
+import dataclasses
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.preprocessing.layers import IndexLookup, _stable_hash64
+
+
+@dataclasses.dataclass(frozen=True)
+class NumericColumn:
+    key: str
+    shape: tuple = (1,)
+    normalizer_fn: object = None  # callable array -> array
+
+
+@dataclasses.dataclass(frozen=True)
+class IdentityCategoricalColumn:
+    key: str
+    num_buckets: int
+
+
+@dataclasses.dataclass(frozen=True)
+class HashedCategoricalColumn:
+    key: str
+    hash_bucket_size: int
+
+
+@dataclasses.dataclass(frozen=True)
+class VocabularyCategoricalColumn:
+    key: str
+    vocabulary: tuple
+    num_oov_indices: int = 1
+
+    @property
+    def num_buckets(self):
+        return len(self.vocabulary) + self.num_oov_indices
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketizedColumn:
+    key: str
+    boundaries: tuple
+
+    @property
+    def num_buckets(self):
+        return len(self.boundaries) + 1
+
+
+@dataclasses.dataclass(frozen=True)
+class EmbeddingColumn:
+    categorical: object
+    dimension: int
+    combiner: str = "mean"
+    initializer_stddev: float = None  # default 1/sqrt(dim)
+
+
+@dataclasses.dataclass(frozen=True)
+class IndicatorColumn:
+    categorical: object
+
+
+def numeric_column(key, shape=(1,), normalizer_fn=None):
+    return NumericColumn(key, tuple(shape), normalizer_fn)
+
+
+def categorical_column_with_identity(key, num_buckets):
+    return IdentityCategoricalColumn(key, num_buckets)
+
+
+def categorical_column_with_hash_bucket(key, hash_bucket_size):
+    return HashedCategoricalColumn(key, hash_bucket_size)
+
+
+def categorical_column_with_vocabulary_list(
+    key, vocabulary, num_oov_indices=1
+):
+    return VocabularyCategoricalColumn(
+        key, tuple(vocabulary), num_oov_indices
+    )
+
+
+def bucketized_column(key, boundaries):
+    """Numeric -> bucket id by boundaries. Pure in-graph (searchsorted),
+    so it needs no host-side preprocess step."""
+    return BucketizedColumn(key, tuple(sorted(boundaries)))
+
+
+def embedding_column(
+    categorical, dimension, combiner="mean", initializer_stddev=None
+):
+    """PS-aware embedding column: the table lives in params for small
+    vocabs and is auto-swapped to the PS when it exceeds the ModelHandler
+    threshold (reference feature_column.py:25-221 semantics)."""
+    if dimension is None or dimension < 1:
+        raise ValueError(f"invalid embedding dimension {dimension}")
+    return EmbeddingColumn(categorical, dimension, combiner,
+                           initializer_stddev)
+
+
+def indicator_column(categorical):
+    return IndicatorColumn(categorical)
+
+
+def _bucket_count(categorical):
+    if isinstance(categorical, HashedCategoricalColumn):
+        return categorical.hash_bucket_size
+    return categorical.num_buckets
+
+
+def _is_int_array(raw):
+    dtype = getattr(raw, "dtype", None)
+    return dtype is not None and np.issubdtype(
+        np.dtype(str(dtype)), np.integer
+    )
+
+
+def _jnp_int_hash(ids):
+    """In-graph 32-bit finalizer (lowbias32): decorrelates raw integer ids
+    before the bucket modulo, like the host-side Hashing layer does for
+    strings. Pure jnp, so hashed columns with integer inputs work inside
+    jit."""
+    x = jnp.asarray(ids).astype(jnp.uint32)
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    return x ^ (x >> 16)
+
+
+def _categorical_ids(categorical, features):
+    """Column spec + feature batch -> int id array.
+
+    Hashing/vocab transforms run on STRINGS and therefore on the host —
+    under jit, call `DenseFeatures.preprocess` in the data feed first
+    (it replaces those keys with int ids, which pass through here as
+    identity). Bucketize/identity lower to pure in-graph ops."""
+    raw = features[categorical.key]
+    if isinstance(categorical, BucketizedColumn):
+        return jnp.searchsorted(
+            jnp.asarray(categorical.boundaries, jnp.float32),
+            jnp.asarray(raw, jnp.float32),
+            side="right",
+        ).astype(jnp.int32)
+    if isinstance(categorical, HashedCategoricalColumn):
+        if not (_is_int_array(raw) or isinstance(raw, jnp.ndarray)):
+            # Strings reduce to raw 63-bit hashes host-side (same step
+            # preprocess() performs), so every input path runs EXACTLY one
+            # in-graph mix+modulo below.
+            arr = np.asarray(raw)
+            raw = np.asarray(
+                [
+                    _stable_hash64(s) & 0x7FFFFFFFFFFFFFFF
+                    for s in arr.reshape(-1)
+                ],
+                np.int64,
+            ).reshape(arr.shape)
+        # Integer ids are NOT assumed pre-bucketed (a raw Criteo id can be
+        # millions): mix + modulo in-graph.
+        return (
+            _jnp_int_hash(raw) % jnp.uint32(categorical.hash_bucket_size)
+        ).astype(jnp.int32)
+    if isinstance(categorical, IdentityCategoricalColumn) or _is_int_array(
+        raw
+    ):
+        return jnp.asarray(raw, jnp.int32)
+    if isinstance(categorical, VocabularyCategoricalColumn):
+        lookup = IndexLookup(
+            list(categorical.vocabulary),
+            num_oov_indices=categorical.num_oov_indices,
+        )
+        return jnp.asarray(lookup(np.asarray(raw)), jnp.int32)
+    raise TypeError(f"not a categorical column: {categorical!r}")
+
+
+def _walk_categoricals(columns):
+    for col in columns:
+        if isinstance(col, (EmbeddingColumn, IndicatorColumn)):
+            yield col.categorical
+
+
+def _combine(embedded, combiner):
+    if embedded.ndim == 2:  # single id per example: nothing to combine
+        return embedded
+    if combiner == "sum":
+        return jnp.sum(embedded, axis=-2)
+    if combiner == "mean":
+        return jnp.mean(embedded, axis=-2)
+    if combiner == "sqrtn":
+        n = embedded.shape[-2]
+        return jnp.sum(embedded, axis=-2) / math.sqrt(n)
+    raise ValueError(f"unknown combiner {combiner!r}")
+
+
+class DenseFeatures(nn.Module):
+    """Lowers a list of column specs against a feature dict into one dense
+    [batch, total_width] tensor (the tf.keras DenseFeatures analog).
+
+    String-keyed transforms (hash buckets, vocabulary lookups) cannot run
+    inside a compiled step: call `preprocess(features)` in the data feed
+    (host side) — it replaces those keys with int id arrays — and the
+    module's in-graph `__call__` handles the rest."""
+
+    columns: tuple
+
+    def preprocess(self, features):
+        """Host-side transform pass: hash/vocab string columns -> int id
+        arrays under the same keys. Safe to call on already-transformed
+        batches (int inputs pass through)."""
+        out = dict(features)
+        for cat in _walk_categoricals(self.columns):
+            raw = out.get(cat.key)
+            if raw is None or _is_int_array(raw):
+                continue
+            if isinstance(cat, HashedCategoricalColumn):
+                # Strings become RAW 63-bit hashes, NOT bucket ids: the
+                # in-graph mix+modulo does the single bucketing step, so
+                # values never get hashed twice (double-hashing collapses
+                # buckets).
+                arr = np.asarray(raw)
+                out[cat.key] = np.asarray(
+                    [
+                        _stable_hash64(s) & 0x7FFFFFFFFFFFFFFF
+                        for s in arr.reshape(-1)
+                    ],
+                    np.int64,
+                ).reshape(arr.shape)
+            elif isinstance(cat, VocabularyCategoricalColumn):
+                lookup = IndexLookup(
+                    list(cat.vocabulary),
+                    num_oov_indices=cat.num_oov_indices,
+                )
+                out[cat.key] = np.asarray(lookup(np.asarray(raw)))
+        return out
+
+    @nn.compact
+    def __call__(self, features):
+        pieces = []
+        for col in self.columns:
+            if isinstance(col, NumericColumn):
+                value = jnp.asarray(features[col.key], jnp.float32)
+                if col.normalizer_fn is not None:
+                    value = col.normalizer_fn(value)
+                pieces.append(value.reshape(value.shape[0], -1))
+            elif isinstance(col, EmbeddingColumn):
+                ids = _categorical_ids(col.categorical, features)
+                stddev = col.initializer_stddev or (
+                    1.0 / math.sqrt(col.dimension)
+                )
+                table = nn.Embed(
+                    num_embeddings=_bucket_count(col.categorical),
+                    features=col.dimension,
+                    embedding_init=nn.initializers.truncated_normal(
+                        stddev
+                    ),
+                    name=f"emb_{col.categorical.key}",
+                )
+                embedded = _combine(table(ids), col.combiner)
+                pieces.append(embedded.reshape(embedded.shape[0], -1))
+            elif isinstance(col, IndicatorColumn):
+                ids = _categorical_ids(col.categorical, features)
+                # Multi-hot over the bucket count (multivalent ids sum).
+                one_hot = jax.nn.one_hot(
+                    ids.reshape(ids.shape[0], -1),
+                    _bucket_count(col.categorical),
+                    dtype=jnp.float32,
+                )
+                pieces.append(jnp.sum(one_hot, axis=1))
+            else:
+                raise TypeError(f"unsupported column {col!r}")
+        return jnp.concatenate(pieces, axis=-1)

@@ -84,3 +84,27 @@ def test_dac_ctr_variant_trains(spec_name):
         assert np.isfinite(metric.result())
     # The synthetic labels carry embedding signal: AUC beats coin flip.
     assert metrics["auc"].result() > 0.52
+
+
+def test_deepctr_wdl_trains():
+    """The deepctr-style WDL (spec-driven feature columns over Criteo
+    shapes, reference model_zoo/deepctr/wdl.py) builds and converges."""
+    from elasticdl_tpu.common.model_utils import Modes, get_model_spec
+    from elasticdl_tpu.worker.trainer import LocalTrainer
+
+    spec = get_model_spec("elasticdl_tpu.models.deepctr.wdl")
+    trainer = LocalTrainer(
+        spec.build_model(), spec.loss, spec.build_optimizer_spec()
+    )
+    records = list(iter_criteo_records(256, seed=11))
+    features, labels = spec.feed(records, Modes.TRAINING, None)
+    losses = []
+    for _ in range(25):
+        _, _, loss = trainer.train_minibatch(features, labels)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.95, (losses[0], losses[-1])
+    outputs = trainer.evaluate_minibatch(features)
+    for metric in spec.build_metrics().values():
+        metric.update(outputs, labels)
+        assert np.isfinite(metric.result())

@@ -1,0 +1,137 @@
+"""Feature columns + analyzer utils (reference feature_column.py /
+analyzer_utils.py behavior on the flax lowering)."""
+
+import jax
+import numpy as np
+import pytest
+
+from elasticdl_tpu.preprocessing import analyzer_utils
+from elasticdl_tpu.preprocessing import feature_column as fc
+
+
+def _params(model, feats):
+    return model.init({"params": jax.random.PRNGKey(0)}, feats)
+
+
+def test_numeric_and_identity_embedding_columns():
+    columns = (
+        fc.numeric_column("price"),
+        fc.embedding_column(
+            fc.categorical_column_with_identity("item", num_buckets=10),
+            dimension=4,
+            combiner="sum",
+        ),
+    )
+    model = fc.DenseFeatures(columns)
+    feats = {
+        "price": np.array([[1.0], [2.0]], np.float32),
+        "item": np.array([[1, 2], [3, 3]], np.int64),
+    }
+    variables = _params(model, feats)
+    out = model.apply(variables, feats)
+    assert out.shape == (2, 1 + 4)
+    np.testing.assert_allclose(out[:, 0], [1.0, 2.0])
+    table = variables["params"]["emb_item"]["embedding"]
+    np.testing.assert_allclose(
+        np.asarray(out[0, 1:]), np.asarray(table[1] + table[2]), rtol=1e-6
+    )
+    # 'mean'/'sqrtn' combiners normalize the sum.
+    mean_model = fc.DenseFeatures(
+        (
+            fc.embedding_column(
+                fc.categorical_column_with_identity("item", 10),
+                4,
+                combiner="mean",
+            ),
+        )
+    )
+    mv = _params(mean_model, feats)
+    mo = mean_model.apply(mv, feats)
+    t = mv["params"]["emb_item"]["embedding"]
+    np.testing.assert_allclose(
+        np.asarray(mo[1]), np.asarray(t[3]), rtol=1e-6
+    )
+
+
+def test_hashed_and_vocab_columns():
+    columns = (
+        fc.embedding_column(
+            fc.categorical_column_with_hash_bucket("cat", 32), 4
+        ),
+        fc.indicator_column(
+            fc.categorical_column_with_vocabulary_list(
+                "color", ["red", "green", "blue"]
+            )
+        ),
+    )
+    model = fc.DenseFeatures(columns)
+    feats = {
+        "cat": np.array([["a"], ["b"]]),
+        "color": np.array([["red"], ["purple"]]),
+    }
+    variables = _params(model, feats)
+    out = model.apply(variables, feats)
+    # 4 (embedding) + 4 (3 vocab + 1 oov indicator)
+    assert out.shape == (2, 8)
+    hot = np.asarray(out[:, 4:])
+    # IndexLookup maps vocab to [0, len) and OOV to the tail bucket.
+    assert hot[0].tolist() == [1.0, 0.0, 0.0, 0.0]  # red = 0
+    assert hot[1].tolist() == [0.0, 0.0, 0.0, 1.0]  # purple -> oov = 3
+
+
+def test_embedding_column_swaps_to_ps_when_large():
+    """The ModelHandler picks up feature-column embeddings like any
+    nn.Embed: over-threshold tables leave params for the PS collection."""
+    from elasticdl_tpu.common.model_handler import wrap_model_for_ps
+    from elasticdl_tpu.layers.embedding import EMBEDDING_COLLECTION
+
+    columns = (
+        fc.embedding_column(
+            fc.categorical_column_with_identity("item", 1000), 8
+        ),
+    )
+    wrapped = wrap_model_for_ps(
+        fc.DenseFeatures(columns), threshold_bytes=1024
+    )
+    feats = {"item": np.array([[1], [2]], np.int64)}
+    variables = wrapped.init({"params": jax.random.PRNGKey(0)}, feats)
+    assert "emb_item" not in variables.get("params", {}).get("inner", {})
+    assert set(variables[EMBEDDING_COLLECTION]) == {"emb_item"}
+
+
+def test_bad_columns():
+    with pytest.raises(ValueError):
+        fc.embedding_column(
+            fc.categorical_column_with_identity("x", 5), 0
+        )
+    model = fc.DenseFeatures(
+        (
+            fc.embedding_column(
+                fc.categorical_column_with_identity("x", 5),
+                2,
+                combiner="median",
+            ),
+        )
+    )
+    feats = {"x": np.array([[1, 2]], np.int64)}
+    with pytest.raises(ValueError):
+        model.init({"params": jax.random.PRNGKey(0)}, feats)
+
+
+def test_analyzer_utils_env_contract(monkeypatch):
+    assert analyzer_utils.get_min("age", 3.0) == 3.0
+    monkeypatch.setenv("_age_min", "18")
+    monkeypatch.setenv("_age_stddev", "2.5")
+    monkeypatch.setenv("_fare_boundaries", "30,10,20")
+    monkeypatch.setenv("_city_vocab", "bj,sh,sz")
+    monkeypatch.setenv("_city_distinct_count", "3")
+    assert analyzer_utils.get_min("age", 0.0) == 18.0
+    assert analyzer_utils.get_stddev("age", 1.0) == 2.5
+    assert analyzer_utils.get_bucket_boundaries("fare", []) == [
+        10.0,
+        20.0,
+        30.0,
+    ]
+    assert analyzer_utils.get_vocabulary("city", []) == ["bj", "sh", "sz"]
+    assert analyzer_utils.get_distinct_count("city", 0) == 3
+    assert analyzer_utils.get_avg("other", 7.5) == 7.5

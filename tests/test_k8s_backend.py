@@ -1,0 +1,243 @@
+"""K8s backend logic without a cluster: spec parsers, and the
+event -> relaunch -> membership state machine driven through a fake watch
+stream (the reference gates its equivalents behind K8S_TESTS on a real
+cluster, k8s_instance_manager_test.py:25; here the watch events are faked
+so the relaunch policy has coverage everywhere)."""
+
+from types import SimpleNamespace
+
+import pytest
+
+from elasticdl_tpu.common import k8s_client
+from elasticdl_tpu.common.k8s_resource import (
+    parse_resource_spec,
+    parse_volume_spec,
+    parse_worker_priority,
+)
+from elasticdl_tpu.master.k8s_instance_manager import K8sInstanceManager
+from elasticdl_tpu.master.membership import MembershipManager
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+
+# ---------- parsers ----------
+
+
+def test_parse_resource_spec():
+    assert parse_resource_spec("cpu=250m,memory=32Mi,gpu=1,tpu=4") == {
+        "cpu": "250m",
+        "memory": "32Mi",
+        "nvidia.com/gpu": "1",
+        "google.com/tpu": "4",
+    }
+    assert parse_resource_spec("cpu=2.5,ephemeral-storage=1Gi") == {
+        "cpu": "2.5",
+        "ephemeral-storage": "1Gi",
+    }
+    assert parse_resource_spec("amd.com/gpu=2") == {"amd.com/gpu": "2"}
+    assert parse_resource_spec("") == {}
+    for bad in (
+        "memory=abc",
+        "cpu=x",
+        "gpu=1.5",
+        "flux_capacitors=1",
+        "cpu",
+    ):
+        with pytest.raises(ValueError):
+            parse_resource_spec(bad)
+
+
+def test_parse_volume_spec():
+    vols = parse_volume_spec(
+        "host_path=/data,mount_path=/data;"
+        "claim_name=c1,mount_path=/m1,sub_path=s0"
+    )
+    assert vols == [
+        {"kind": "host_path", "source": "/data", "mount_path": "/data"},
+        {
+            "kind": "pvc",
+            "source": "c1",
+            "mount_path": "/m1",
+            "sub_path": "s0",
+        },
+    ]
+    with pytest.raises(ValueError):
+        parse_volume_spec("host_path=/data")  # no mount_path
+    with pytest.raises(ValueError):
+        parse_volume_spec("mount_path=/only")  # no source
+
+
+def test_parse_worker_priority():
+    assert parse_worker_priority("high=0.5", 4) == {
+        0: "high",
+        1: "high",
+        2: "low",
+        3: "low",
+    }
+    assert parse_worker_priority("critical", 2) == {
+        0: "critical",
+        1: "critical",
+    }
+    assert parse_worker_priority("", 2) == {0: None, 1: None}
+    # Malformed fraction degrades to unset, not a crash.
+    assert parse_worker_priority("high=abc", 2) == {0: None, 1: None}
+
+
+# ---------- fake watch stream -> state machine ----------
+
+
+class FakeK8sClient:
+    """Stands in for common/k8s_client.Client: records pod/service calls
+    and lets tests push watch events through the manager's callback."""
+
+    instances = []
+
+    def __init__(self, namespace, job_name, image_name, event_callback=None):
+        self.namespace = namespace
+        self.job_name = job_name
+        self.image_name = image_name
+        self.event_cb = event_callback
+        self.created = []  # (kind, id, kwargs)
+        self.services = []
+        self.deleted = []
+        FakeK8sClient.instances.append(self)
+
+    def create_pod(self, replica_type, replica_index, command, **kwargs):
+        self.created.append((replica_type, replica_index, kwargs))
+
+    def create_service(self, name, port, replica_type, replica_index):
+        self.services.append((name, port, replica_type, replica_index))
+
+    def delete_pod(self, replica_type, replica_index):
+        self.deleted.append((replica_type, replica_index))
+
+
+def _pod_event(kind, index, phase, event_type="MODIFIED", exit_code=None,
+               reason=None):
+    statuses = []
+    if exit_code is not None:
+        statuses = [
+            SimpleNamespace(
+                state=SimpleNamespace(
+                    terminated=SimpleNamespace(
+                        exit_code=exit_code, reason=reason
+                    )
+                )
+            )
+        ]
+    pod = SimpleNamespace(
+        metadata=SimpleNamespace(
+            labels={
+                k8s_client.ELASTICDL_REPLICA_TYPE_KEY: kind,
+                k8s_client.ELASTICDL_REPLICA_INDEX_KEY: str(index),
+            }
+        ),
+        status=SimpleNamespace(
+            phase=phase, container_statuses=statuses
+        ),
+    )
+    return {"type": event_type, "object": pod}
+
+
+@pytest.fixture
+def manager(monkeypatch):
+    monkeypatch.setattr(k8s_client, "require_k8s", lambda: None)
+    monkeypatch.setattr(k8s_client, "Client", FakeK8sClient)
+    FakeK8sClient.instances = []
+    task_d = TaskDispatcher(
+        {"f": (0, 40)}, records_per_task=10, shuffle=False
+    )
+    membership = MembershipManager()
+    membership.register(0, "host-a:1")
+    membership.register(1, "host-b:1")
+    mgr = K8sInstanceManager(
+        "ns",
+        "job",
+        "img",
+        lambda kind, i: ["cmd", kind, str(i)],
+        num_workers=2,
+        num_ps=1,
+        task_dispatcher=task_d,
+        membership=membership,
+        worker_resources="cpu=1,memory=1Gi",
+        worker_priority="high=0.5",
+        volumes="host_path=/data,mount_path=/data",
+        max_relaunches=1,
+    )
+    mgr.start_parameter_servers()
+    mgr.start_workers()
+    return mgr, FakeK8sClient.instances[-1], task_d, membership
+
+
+def test_start_passes_parsed_specs(manager):
+    mgr, client, task_d, membership = manager
+    kinds = [(k, i) for k, i, _ in client.created]
+    assert kinds == [("ps", 0), ("worker", 0), ("worker", 1)]
+    _, _, w0 = client.created[1]
+    _, _, w1 = client.created[2]
+    assert w0["resource_requests"] == {"cpu": "1", "memory": "1Gi"}
+    assert w0["priority_class"] == "high"
+    assert w1["priority_class"] == "low"
+    assert w0["volumes"][0]["mount_path"] == "/data"
+    # PS got a stable service for transparent re-seed after relaunch.
+    assert client.services[0][0] == "job-ps-0"
+
+
+def test_deleted_worker_recovers_tasks_and_relaunches(manager):
+    mgr, client, task_d, membership = manager
+    # Worker 0 takes two tasks, then its pod is deleted (preemption).
+    t1, _ = task_d.get(0)
+    t2, _ = task_d.get(0)
+    assert task_d.counts() == {"todo": 2, "doing": 2}
+    client.event_cb(_pod_event("worker", 0, "Running"))
+    client.event_cb(
+        _pod_event("worker", 0, "Failed", event_type="DELETED")
+    )
+    # Tasks recovered, membership dropped, pod relaunched with priority.
+    assert task_d.counts() == {"todo": 4, "doing": 0}
+    assert "host-a:1" not in membership.worker_hosts
+    relaunches = [
+        (k, i) for k, i, _ in client.created if (k, i) == ("worker", 0)
+    ]
+    assert len(relaunches) == 2
+    # A second deletion exceeds max_relaunches=1: worker 0 stays FAILED.
+    client.event_cb(
+        _pod_event("worker", 0, "Failed", event_type="DELETED")
+    )
+    assert (
+        len(
+            [
+                (k, i)
+                for k, i, _ in client.created
+                if (k, i) == ("worker", 0)
+            ]
+        )
+        == 2
+    )
+    assert not mgr.all_workers_failed()  # worker 1 is still live
+
+
+def test_oom_kill_is_not_preemption(manager):
+    mgr, client, task_d, membership = manager
+    before = len(client.created)
+    client.event_cb(
+        _pod_event(
+            "worker", 1, "Failed", exit_code=137, reason="OOMKilled"
+        )
+    )
+    assert len(client.created) == before  # no relaunch
+    client.event_cb(
+        _pod_event("worker", 0, "Failed", exit_code=137, reason="Evicted")
+    )
+    assert len(client.created) == before + 1  # eviction relaunches
+
+
+def test_succeeded_worker_leaves_membership(manager):
+    mgr, client, task_d, membership = manager
+    client.event_cb(_pod_event("worker", 1, "Succeeded"))
+    assert "host-b:1" not in membership.worker_hosts
+    client.event_cb(_pod_event("worker", 0, "Succeeded"))
+    assert mgr.all_workers_done()
+
+
+def test_disk_maps_to_ephemeral_storage():
+    assert parse_resource_spec("disk=2Gi") == {"ephemeral-storage": "2Gi"}

@@ -70,3 +70,17 @@ def test_merge_indexed_slices():
     )
     np.testing.assert_array_equal(unique, [0, 5])
     np.testing.assert_allclose(summed[1], 3 * np.ones(3))
+
+
+def test_string_and_bytes_tensor_roundtrip():
+    """DT_STRING carries UTF-8 text AND binary bytes features."""
+    arr = np.array(["héllo", "", "world"], dtype=object)
+    out = tensor_utils.tensor_pb_to_ndarray(
+        tensor_utils.ndarray_to_tensor_pb(arr, "s")
+    )
+    assert out.tolist() == ["héllo", "", "world"]
+    raw = np.array([b"\xff\xfe", b"ok"], dtype=object)
+    out = tensor_utils.tensor_pb_to_ndarray(
+        tensor_utils.ndarray_to_tensor_pb(raw, "b")
+    )
+    assert out.tolist() == [b"\xff\xfe", "ok"]

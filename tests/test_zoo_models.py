@@ -34,6 +34,7 @@ CONVERGING_MODELS = [
     ("elasticdl_tpu.models.census.dnn", 60, 0.8),
     ("elasticdl_tpu.models.deepfm.deepfm_functional", 30, 0.7),
     ("elasticdl_tpu.models.heart.heart_model", 30, 0.8),
+    ("elasticdl_tpu.models.census_fc.wide_deep_fc", 30, 0.8),
 ]
 
 
