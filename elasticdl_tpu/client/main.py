@@ -82,32 +82,14 @@ def _master_pod_manifest(job_args, raw_argv):
     )
     # The master reads the training data itself (shard creation), so it
     # needs the same --volume mounts the worker/PS pods get.
-    volumes, mounts, by_source = [], [], {}
-    from elasticdl_tpu.common.k8s_resource import parse_volume_spec
+    from elasticdl_tpu.common.k8s_resource import (
+        group_volume_manifests,
+        parse_volume_spec,
+    )
 
-    for vd in parse_volume_spec(getattr(job_args, "volume", "")):
-        key = (vd["kind"], vd["source"])
-        name = by_source.get(key)
-        if name is None:
-            name = f"edl-vol-{len(volumes)}"
-            by_source[key] = name
-            if vd["kind"] == "pvc":
-                volumes.append(
-                    {
-                        "name": name,
-                        "persistentVolumeClaim": {
-                            "claimName": vd["source"]
-                        },
-                    }
-                )
-            else:
-                volumes.append(
-                    {"name": name, "hostPath": {"path": vd["source"]}}
-                )
-        mount = {"name": name, "mountPath": vd["mount_path"]}
-        if "sub_path" in vd:
-            mount["subPath"] = vd["sub_path"]
-        mounts.append(mount)
+    volumes, mounts = group_volume_manifests(
+        parse_volume_spec(getattr(job_args, "volume", ""))
+    )
     return {
         "apiVersion": "v1",
         "kind": "Pod",
@@ -360,6 +342,32 @@ def _top(args):
     return 0
 
 
+def _tensorboard(args):
+    """Spawn TensorBoard over a job's metrics directory (reference
+    master/tensorboard_service.py:21-62 spawns the CLI the same way; the
+    master here only writes event files — serving them is this separate,
+    optional process)."""
+    import shutil as _shutil
+    import subprocess
+
+    if _shutil.which("tensorboard") is None:
+        logger.error(
+            "tensorboard CLI not found; install tensorboard or point any "
+            "TensorBoard at --logdir %s",
+            args.metrics_dir,
+        )
+        return 1
+    cmd = [
+        "tensorboard",
+        "--logdir",
+        args.metrics_dir,
+        "--port",
+        str(args.port),
+        "--bind_all",
+    ]
+    return subprocess.run(cmd).returncode
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     top = argparse.ArgumentParser(
@@ -367,9 +375,16 @@ def main(argv=None):
     )
     top.add_argument(
         "command",
-        choices=["train", "evaluate", "predict", "zoo", "top"],
+        choices=["train", "evaluate", "predict", "zoo", "top",
+                 "tensorboard"],
     )
     ns, rest = top.parse_known_args(argv)
+
+    if ns.command == "tensorboard":
+        tb = argparse.ArgumentParser("edl tensorboard")
+        tb.add_argument("--metrics_dir", required=True)
+        tb.add_argument("--port", type=int, default=6006)
+        return _tensorboard(tb.parse_args(rest))
 
     if ns.command == "top":
         monitor = argparse.ArgumentParser("edl top")

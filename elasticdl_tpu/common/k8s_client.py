@@ -29,45 +29,47 @@ ELASTICDL_REPLICA_INDEX_KEY = "elasticdl-replica-index"
 
 def build_volumes(volume_dicts):
     """Parsed volume dicts (common/k8s_resource.parse_volume_spec) ->
-    (V1Volume list, V1VolumeMount list). Mounts sharing a source share one
-    volume (the reference dedupes the same way, k8s_volume.py:47-81)."""
+    (V1Volume list, V1VolumeMount list). The grouping/dedup logic lives
+    in k8s_resource.group_volume_manifests (shared with the master-pod
+    manifest builder); this only converts dict manifests to V1 objects."""
     if not volume_dicts:
         return [], []
     require_k8s()
-    volumes, mounts, by_source = [], [], {}
-    for i, vd in enumerate(volume_dicts):
-        key = (vd["kind"], vd["source"])
-        name = by_source.get(key)
-        if name is None:
-            name = f"edl-vol-{len(volumes)}"
-            by_source[key] = name
-            if vd["kind"] == "pvc":
-                volumes.append(
-                    k8s_api.V1Volume(
-                        name=name,
-                        persistent_volume_claim=(
-                            k8s_api.V1PersistentVolumeClaimVolumeSource(
-                                claim_name=vd["source"], read_only=False
-                            )
-                        ),
-                    )
+    from elasticdl_tpu.common.k8s_resource import group_volume_manifests
+
+    vol_manifests, mount_manifests = group_volume_manifests(volume_dicts)
+    volumes = []
+    for v in vol_manifests:
+        if "persistentVolumeClaim" in v:
+            pvc = v["persistentVolumeClaim"]
+            volumes.append(
+                k8s_api.V1Volume(
+                    name=v["name"],
+                    persistent_volume_claim=(
+                        k8s_api.V1PersistentVolumeClaimVolumeSource(
+                            claim_name=pvc["claimName"],
+                            read_only=pvc["readOnly"],
+                        )
+                    ),
                 )
-            else:
-                volumes.append(
-                    k8s_api.V1Volume(
-                        name=name,
-                        host_path=k8s_api.V1HostPathVolumeSource(
-                            path=vd["source"]
-                        ),
-                    )
-                )
-        mounts.append(
-            k8s_api.V1VolumeMount(
-                name=name,
-                mount_path=vd["mount_path"],
-                sub_path=vd.get("sub_path"),
             )
+        else:
+            volumes.append(
+                k8s_api.V1Volume(
+                    name=v["name"],
+                    host_path=k8s_api.V1HostPathVolumeSource(
+                        path=v["hostPath"]["path"]
+                    ),
+                )
+            )
+    mounts = [
+        k8s_api.V1VolumeMount(
+            name=m["name"],
+            mount_path=m["mountPath"],
+            sub_path=m.get("subPath"),
         )
+        for m in mount_manifests
+    ]
     return volumes, mounts
 
 

@@ -20,7 +20,10 @@ from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("common.k8s_resource")
 
-_MEM_RE = re.compile(r"^[1-9][0-9]*(E|P|T|G|M|K|Ei|Pi|Ti|Gi|Mi|Ki)?$")
+# Kubernetes quantities allow decimals ("1.5Gi", "0.5G").
+_MEM_RE = re.compile(
+    r"^(0|[1-9][0-9]*)(\.[0-9]+)?(E|P|T|G|M|K|Ei|Pi|Ti|Gi|Mi|Ki)?$"
+)
 _CPU_MILLI_RE = re.compile(r"^[1-9][0-9]*m$")
 _DEVICE_DOMAIN_RE = re.compile(
     r"^[a-zA-Z\d-]{1,63}(\.[a-zA-Z\d-]{1,63})*/(gpu|tpu)$"
@@ -131,6 +134,40 @@ def parse_volume_spec(spec):
     return volumes
 
 
+def group_volume_manifests(volume_dicts):
+    """Parsed volume dicts -> (pod volume manifests, container mount
+    manifests) in plain k8s JSON form, deduplicated by source (one volume,
+    many mounts). The ONLY place the grouping/branching lives: the master
+    manifest uses these dicts verbatim and the kubernetes client converts
+    them to V1 objects."""
+    volumes, mounts, by_source = [], [], {}
+    for vd in volume_dicts:
+        key = (vd["kind"], vd["source"])
+        name = by_source.get(key)
+        if name is None:
+            name = f"edl-vol-{len(volumes)}"
+            by_source[key] = name
+            if vd["kind"] == "pvc":
+                volumes.append(
+                    {
+                        "name": name,
+                        "persistentVolumeClaim": {
+                            "claimName": vd["source"],
+                            "readOnly": False,
+                        },
+                    }
+                )
+            else:
+                volumes.append(
+                    {"name": name, "hostPath": {"path": vd["source"]}}
+                )
+        mount = {"name": name, "mountPath": vd["mount_path"]}
+        if "sub_path" in vd:
+            mount["subPath"] = vd["sub_path"]
+        mounts.append(mount)
+    return volumes, mounts
+
+
 def parse_worker_priority(spec, num_workers):
     """Per-worker priority classes. 'high=0.5' gives the first half of the
     workers the 'high' class and the rest 'low' (the reference's fraction
@@ -152,4 +189,13 @@ def parse_worker_priority(spec, num_workers):
             i: ("high" if i < high else "low")
             for i in range(num_workers)
         }
+    if "=" in spec:
+        # Anything else containing '=' is a malformed fraction spec, NOT
+        # a literal class name ('low=0.3' is never a valid k8s
+        # priorityClassName) — fail at parse time, not pod creation.
+        raise ValueError(
+            f"bad worker priority spec {spec!r}: the fraction form is "
+            "'high=<fraction>'; otherwise give a plain priority class "
+            "name"
+        )
     return {i: spec for i in range(num_workers)}

@@ -43,22 +43,45 @@ def pb_dtype_to_np(dtype_enum: int) -> np.dtype:
 
 
 def _is_string_array(arr):
-    return arr.dtype.kind in ("U", "S", "O", "T")
+    if arr.dtype.kind in ("U", "S", "T"):
+        return True
+    if arr.dtype.kind == "O":
+        # Object arrays are accepted ONLY when they hold text/bytes — a
+        # numeric/ragged object array must keep the loud unsupported-dtype
+        # error instead of serializing reprs.
+        if arr.size == 0:
+            return True
+        if all(
+            isinstance(s, (str, bytes)) for s in arr.reshape(-1)
+        ):
+            return True
+        raise ValueError(
+            "object-dtype array holds non-string elements; convert to a "
+            "numeric dtype before wire transfer"
+        )
+    return False
 
 
 def ndarray_to_tensor_pb(arr: np.ndarray, name: str = "") -> pb.Tensor:
     arr = np.asarray(arr)  # not ascontiguousarray: that promotes 0-d to 1-d
     if _is_string_array(arr):
-        # Variable-length strings: concatenated UTF-8 bytes + per-element
-        # lengths (the reference carries these as TF bytes features).
+        # Variable-length text/bytes: concatenated payload + per-element
+        # lengths (the reference carries these as TF bytes features). ONE
+        # wire type per tensor: any bytes element makes the whole tensor
+        # DT_BYTES (every element decodes as bytes), otherwise DT_STRING
+        # (every element decodes as str) — never content-dependent mixes.
+        flat = list(arr.reshape(-1))
+        any_bytes = any(isinstance(s, bytes) for s in flat) or (
+            arr.dtype.kind == "S"
+        )
         encoded = [
             s if isinstance(s, bytes) else str(s).encode("utf-8")
-            for s in arr.reshape(-1)
+            for s in flat
         ]
         return pb.Tensor(
             name=name,
             dims=list(arr.shape),
-            dtype=pb.DT_STRING,
+            dtype=pb.DT_BYTES if any_bytes else pb.DT_STRING,
             content=b"".join(encoded),
             string_lengths=[len(e) for e in encoded],
         )
@@ -71,15 +94,12 @@ def ndarray_to_tensor_pb(arr: np.ndarray, name: str = "") -> pb.Tensor:
 
 
 def tensor_pb_to_ndarray(tensor_pb: pb.Tensor) -> np.ndarray:
-    if tensor_pb.dtype == pb.DT_STRING:
+    if tensor_pb.dtype in (pb.DT_STRING, pb.DT_BYTES):
+        as_bytes = tensor_pb.dtype == pb.DT_BYTES
         parts, offset = [], 0
         for length in tensor_pb.string_lengths:
             raw = tensor_pb.content[offset:offset + length]
-            try:
-                parts.append(raw.decode("utf-8"))
-            except UnicodeDecodeError:
-                # Binary bytes features round-trip as bytes.
-                parts.append(raw)
+            parts.append(raw if as_bytes else raw.decode("utf-8"))
             offset += length
         return np.asarray(parts, dtype=object).reshape(
             tuple(tensor_pb.dims)

@@ -19,6 +19,7 @@ layers; statistics-driven defaults come from analyzer_utils (env vars).
 """
 
 import dataclasses
+import functools
 import math
 
 import flax.linen as nn
@@ -183,14 +184,30 @@ def _categorical_ids(categorical, features):
     if isinstance(categorical, IdentityCategoricalColumn) or _is_int_array(
         raw
     ):
-        return jnp.asarray(raw, jnp.int32)
-    if isinstance(categorical, VocabularyCategoricalColumn):
-        lookup = IndexLookup(
-            list(categorical.vocabulary),
-            num_oov_indices=categorical.num_oov_indices,
+        # XLA gathers clamp out-of-range indices; make that explicit so
+        # the behavior is defined (the TF column raises instead — under
+        # jit a data-dependent raise is impossible, so overflow ids pin
+        # to the last bucket and negatives to 0).
+        return jnp.clip(
+            jnp.asarray(raw, jnp.int32),
+            0,
+            _bucket_count(categorical) - 1,
         )
-        return jnp.asarray(lookup(np.asarray(raw)), jnp.int32)
+    if isinstance(categorical, VocabularyCategoricalColumn):
+        return jnp.asarray(
+            _lookup_for(categorical)(np.asarray(raw)), jnp.int32
+        )
     raise TypeError(f"not a categorical column: {categorical!r}")
+
+
+@functools.lru_cache(maxsize=256)
+def _lookup_for(categorical):
+    """One IndexLookup per frozen column spec — preprocess runs per batch
+    in the feed hot path and must not rebuild the vocab dict each call."""
+    return IndexLookup(
+        list(categorical.vocabulary),
+        num_oov_indices=categorical.num_oov_indices,
+    )
 
 
 def _walk_categoricals(columns):
@@ -246,11 +263,9 @@ class DenseFeatures(nn.Module):
                     np.int64,
                 ).reshape(arr.shape)
             elif isinstance(cat, VocabularyCategoricalColumn):
-                lookup = IndexLookup(
-                    list(cat.vocabulary),
-                    num_oov_indices=cat.num_oov_indices,
+                out[cat.key] = np.asarray(
+                    _lookup_for(cat)(np.asarray(raw))
                 )
-                out[cat.key] = np.asarray(lookup(np.asarray(raw)))
         return out
 
     @nn.compact

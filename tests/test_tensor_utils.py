@@ -79,8 +79,17 @@ def test_string_and_bytes_tensor_roundtrip():
         tensor_utils.ndarray_to_tensor_pb(arr, "s")
     )
     assert out.tolist() == ["héllo", "", "world"]
+    # Any bytes element makes the WHOLE tensor DT_BYTES: every element
+    # decodes as bytes (never a content-dependent str/bytes mix).
     raw = np.array([b"\xff\xfe", b"ok"], dtype=object)
     out = tensor_utils.tensor_pb_to_ndarray(
         tensor_utils.ndarray_to_tensor_pb(raw, "b")
     )
-    assert out.tolist() == [b"\xff\xfe", "ok"]
+    assert out.tolist() == [b"\xff\xfe", b"ok"]
+    # Object arrays holding non-strings keep the loud error.
+    import pytest
+
+    with pytest.raises(ValueError, match="non-string"):
+        tensor_utils.ndarray_to_tensor_pb(
+            np.array([1.0, "x"], dtype=object), "bad"
+        )
