@@ -178,12 +178,10 @@ def parse_worker_priority(spec, num_workers):
         try:
             fraction = float(spec.split("=", 1)[1])
         except ValueError:
-            logger.warning(
-                "Bad worker priority %r (expected e.g. high=0.5); "
-                "leaving priorities unset",
-                spec,
+            raise ValueError(
+                f"bad worker priority spec {spec!r}: the fraction form "
+                "is 'high=<fraction>', e.g. high=0.5"
             )
-            return {i: None for i in range(num_workers)}
         high = int(num_workers * fraction)
         return {
             i: ("high" if i < high else "low")

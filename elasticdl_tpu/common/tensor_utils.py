@@ -99,7 +99,15 @@ def tensor_pb_to_ndarray(tensor_pb: pb.Tensor) -> np.ndarray:
         parts, offset = [], 0
         for length in tensor_pb.string_lengths:
             raw = tensor_pb.content[offset:offset + length]
-            parts.append(raw if as_bytes else raw.decode("utf-8"))
+            if as_bytes:
+                parts.append(raw)
+            else:
+                try:
+                    parts.append(raw.decode("utf-8"))
+                except UnicodeDecodeError:
+                    # Record files written before DT_BYTES existed stored
+                    # binary features as DT_STRING; keep reading them.
+                    parts.append(raw)
             offset += length
         return np.asarray(parts, dtype=object).reshape(
             tuple(tensor_pb.dims)

@@ -35,11 +35,13 @@ import os
 import jax
 import jax.numpy as jnp
 
-# Block-size sweep on TPU v5e (S=8192, bf16, causal fwd+bwd): 512-1024
-# square tiles run ~4x faster than 128 tiles (less grid overhead, better
-# MXU occupancy); blocks auto-clamp to S for short sequences.
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
+# Block-size sweep on TPU v5e (S=4096, bf16, causal fwd+bwd, D=64):
+# 1024x1024 tiles run 5.49 ms/step vs 5.93 (512x512) and 6.76 (256x256),
+# and 1.5x faster than the full-matrix XLA path (8.26 ms) — bigger tiles
+# amortize grid overhead and fill the MXU; blocks auto-clamp to S for
+# short sequences.
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
 LANES = 128  # lane replication for row statistics (lse, delta)
 
@@ -446,8 +448,18 @@ def flash_attention(
     return reference_attention(q, k, v, causal)
 
 
+def _fit_block(s, requested):
+    """Largest block <= requested that divides S (halving down to 128), so
+    raising the default block size never kicks divisible-by-512 sequence
+    lengths off the Pallas kernel onto the O(S^2) fallback."""
+    b = min(requested, s)
+    while b > 128 and s % b:
+        b //= 2
+    return b
+
+
 def _clamp_blocks(s, block_q, block_k):
-    return min(block_q, s), min(block_k, s)
+    return _fit_block(s, block_q), _fit_block(s, block_k)
 
 
 def _pallas_ok(s, block_q, block_k):

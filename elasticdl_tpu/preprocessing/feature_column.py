@@ -184,15 +184,7 @@ def _categorical_ids(categorical, features):
     if isinstance(categorical, IdentityCategoricalColumn) or _is_int_array(
         raw
     ):
-        # XLA gathers clamp out-of-range indices; make that explicit so
-        # the behavior is defined (the TF column raises instead — under
-        # jit a data-dependent raise is impossible, so overflow ids pin
-        # to the last bucket and negatives to 0).
-        return jnp.clip(
-            jnp.asarray(raw, jnp.int32),
-            0,
-            _bucket_count(categorical) - 1,
-        )
+        return jnp.asarray(raw, jnp.int32)
     if isinstance(categorical, VocabularyCategoricalColumn):
         return jnp.asarray(
             _lookup_for(categorical)(np.asarray(raw)), jnp.int32
@@ -279,6 +271,13 @@ class DenseFeatures(nn.Module):
                 pieces.append(value.reshape(value.shape[0], -1))
             elif isinstance(col, EmbeddingColumn):
                 ids = _categorical_ids(col.categorical, features)
+                # Gather semantics for out-of-range ids: clamp explicitly
+                # (XLA would clamp anyway; the TF column raises, which a
+                # compiled step cannot). Indicator columns below instead
+                # keep one_hot's drop-to-zero-row behavior.
+                ids = jnp.clip(
+                    ids, 0, _bucket_count(col.categorical) - 1
+                )
                 stddev = col.initializer_stddev or (
                     1.0 / math.sqrt(col.dimension)
                 )

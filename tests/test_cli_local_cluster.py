@@ -179,3 +179,61 @@ def test_metrics_dir_and_top_monitor(tmp_path, linear_data):
         for line in open(os.path.join(metrics_dir, "metrics.jsonl"))
     ]
     assert any(line["group"] == "train" for line in lines)
+
+
+def test_predict_from_checkpoint(tmp_path, linear_data):
+    """`edl predict` loads an exported model and routes outputs through the
+    module's prediction_outputs_processor (the reference's mnist predict
+    CI job, client_test.sh)."""
+    output = str(tmp_path / "model.npz")
+    res = run_edl(
+        "train",
+        "--model_zoo", f"{REPO}/tests",
+        "--model_def", "test_module",
+        "--training_data", linear_data,
+        "--num_epochs", "10",
+        "--records_per_task", "64",
+        "--minibatch_size", "32",
+        "--num_workers", "1",
+        "--distribution_strategy", "Local",
+        "--instance_backend", "local_process",
+        "--master_port", "0",
+        "--output", output,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+
+    predictions_out = str(tmp_path / "predictions.txt")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{REPO}:{REPO}/tests"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["EDL_TEST_PREDICTIONS_OUT"] = predictions_out
+    res = subprocess.run(
+        [
+            sys.executable, "-m", "elasticdl_tpu.client.main", "predict",
+            "--model_zoo", f"{REPO}/tests",
+            "--model_def", "test_module",
+            "--prediction_data", linear_data,
+            "--checkpoint_dir_for_init", output,
+            "--num_workers", "1",
+            "--distribution_strategy", "Local",
+            "--instance_backend", "local_process",
+            "--master_port", "0",
+            "--records_per_task", "64",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        env=env,
+        cwd=REPO,
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    predictions = [
+        float(line) for line in open(predictions_out).read().splitlines()
+    ]
+    assert len(predictions) == 128  # every record predicted exactly once
+    # The restored model predicts the linear target closely.
+    import test_module as tm
+
+    _, labels = tm.feed(tm.make_linear_records(128), "evaluation", None)
+    mse = float(np.mean((np.sort(predictions) - np.sort(labels)) ** 2))
+    assert mse < 0.05, mse

@@ -78,8 +78,11 @@ def test_parse_worker_priority():
         1: "critical",
     }
     assert parse_worker_priority("", 2) == {0: None, 1: None}
-    # Malformed fraction degrades to unset, not a crash.
-    assert parse_worker_priority("high=abc", 2) == {0: None, 1: None}
+    # Malformed fraction specs fail at parse time, not pod creation.
+    with pytest.raises(ValueError):
+        parse_worker_priority("high=abc", 2)
+    with pytest.raises(ValueError):
+        parse_worker_priority("low=0.3", 2)
 
 
 # ---------- fake watch stream -> state machine ----------

@@ -145,3 +145,15 @@ def test_flash_attention_gradients(qkv, monkeypatch):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=5e-2
         )
+
+
+def test_block_fitting_keeps_pallas_for_512_multiples():
+    """Raising the default block must not kick S=1536-style lengths off
+    the Pallas kernel: blocks halve until they divide S."""
+    from elasticdl_tpu.ops.flash_attention import _clamp_blocks
+
+    assert _clamp_blocks(4096, 1024, 1024) == (1024, 1024)
+    assert _clamp_blocks(1536, 1024, 1024) == (512, 512)
+    assert _clamp_blocks(2560, 1024, 1024) == (512, 512)
+    assert _clamp_blocks(384, 1024, 1024) == (384, 384)
+    assert _clamp_blocks(96, 1024, 1024) == (96, 96)

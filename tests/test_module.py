@@ -57,3 +57,22 @@ def make_linear_records(n, seed=0):
     return [
         encode_example({"x": xs[i], "y": np.float32(ys[i])}) for i in range(n)
     ]
+
+
+class _FilePredictionProcessor:
+    """Writes predictions to $EDL_TEST_PREDICTIONS_OUT, one float per line
+    (lets the CLI predict e2e observe outputs across the process
+    boundary)."""
+
+    def process(self, predictions, worker_id):
+        import os
+
+        path = os.environ.get("EDL_TEST_PREDICTIONS_OUT")
+        if not path:
+            return
+        with open(path, "a") as f:
+            for value in np.asarray(predictions).reshape(-1):
+                f.write(f"{float(value)}\n")
+
+
+prediction_outputs_processor = _FilePredictionProcessor()

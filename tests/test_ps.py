@@ -411,3 +411,48 @@ def test_sync_window_timeout_preserves_liveness():
         lone.close()
     finally:
         server.stop()
+
+
+def test_async_concurrent_pushes_are_serialized():
+    """Many clients pushing concurrently: the version lock must serialize
+    the GIL-releasing native applies — the final weight equals exactly
+    -lr * total_pushes (any lost update would show up as a deficit)."""
+    import threading
+
+    server = ParameterServer(0, 1, optimizer_spec=optimizers.sgd(0.5))
+    try:
+        seed = PSClient([server.addr])
+        seed.push_model({"w": np.zeros(64, np.float32)}, [])
+        n_threads, pushes_each = 8, 25
+        errors = []
+
+        def worker(tid):
+            try:
+                client = PSClient([server.addr], worker_id=tid)
+                g = {"w": np.ones(64, np.float32)}
+                for _ in range(pushes_each):
+                    accepted, _ = client.push_gradients(
+                        g, {}, version=0, batch_size=1
+                    )
+                    assert accepted
+                client.close()
+            except Exception as e:  # surface into the main thread
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        total = n_threads * pushes_each
+        _, version, params = seed.pull_dense_parameters(["w"], version=0)
+        assert version == total
+        np.testing.assert_allclose(params["w"], -0.5 * total)
+        assert server.parameters.total_records == total
+        seed.close()
+    finally:
+        server.stop()
