@@ -80,11 +80,13 @@ def _time_step_loop(trainer, features, labels, steps, warmup):
     return time.perf_counter() - start, flops
 
 
-def bench_resnet50(batch_size=128, steps=30, warmup=5):
+def _bench_image_model(model_def, batch_size, steps, warmup):
+    """Shared ImageNet-shape image benchmark: examples/sec, step time, and
+    (when XLA cost analysis yields flops) TFLOP/s + MFU."""
     from elasticdl_tpu.common.model_utils import get_model_spec
     from elasticdl_tpu.worker.trainer import LocalTrainer
 
-    spec = get_model_spec("elasticdl_tpu.models.resnet50.resnet50")
+    spec = get_model_spec(model_def)
     trainer = LocalTrainer(
         spec.build_model(), spec.loss, spec.build_optimizer_spec()
     )
@@ -101,6 +103,25 @@ def bench_resnet50(batch_size=128, steps=30, warmup=5):
         peak = _peak_flops()
         if peak:
             out["mfu"] = flops * steps / elapsed / peak
+    return out
+
+
+def bench_resnet50(batch_size=128, steps=30, warmup=5):
+    return _bench_image_model(
+        "elasticdl_tpu.models.resnet50.resnet50", batch_size, steps, warmup
+    )
+
+
+def bench_mobilenetv2(batch_size=256, steps=30, warmup=5):
+    """Second image benchmark of the reference's table: MobileNetV2 at
+    150 img/s on one P100 (ftlib_benchmark.md:138-156)."""
+    out = _bench_image_model(
+        "elasticdl_tpu.models.mobilenetv2.mobilenetv2",
+        batch_size,
+        steps,
+        warmup,
+    )
+    out["vs_p100_150img_s"] = out["examples_per_sec"] / 150.0
     return out
 
 
@@ -173,6 +194,7 @@ def bench_elastic_rejoin():
 
 def main():
     resnet = bench_resnet50()
+    mobilenet = bench_mobilenetv2()
     deepfm = bench_deepfm_criteo()
     elastic = bench_elastic_rejoin()
     # LocalTrainer's jitted step runs on exactly one device, so its
@@ -182,6 +204,7 @@ def main():
     baseline_img_per_sec = 145.0  # reference ResNet50/ImageNet, 1x P100
     details = {
         "resnet50": {k: round(v, 4) for k, v in resnet.items()},
+        "mobilenetv2": {k: round(v, 4) for k, v in mobilenet.items()},
         "deepfm_criteo": {k: round(v, 4) for k, v in deepfm.items()},
         "deepfm_examples_per_sec_chip": round(
             deepfm["examples_per_sec"], 2

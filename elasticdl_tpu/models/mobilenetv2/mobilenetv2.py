@@ -1,0 +1,150 @@
+"""MobileNetV2 zoo model (ImageNet shapes).
+
+Reference counterpart: the MobileNetV2 benchmark configs in
+/root/reference/docs/benchmark/ftlib_benchmark.md:79-92,138-156 (CIFAR-10
+CPU scaling and ImageNet GPU scaling — 150 img/s on one P100), trained
+through stock keras.applications in the reference zoo style. TPU-first:
+NHWC, bf16 activations with fp32 batch-norm statistics, inverted residual
+blocks as plain flax modules XLA fuses end-to-end.
+"""
+
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from elasticdl_tpu.common.evaluation_utils import accuracy_metric
+from elasticdl_tpu.common.model_utils import Modes
+from elasticdl_tpu.data.example import batch_examples
+from elasticdl_tpu.ops import optimizers
+
+# (expansion t, out channels c, repeats n, stride s) — the V2 paper table.
+_BLOCKS = (
+    (1, 16, 1, 1),
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+)
+
+
+def _round_channels(c, multiplier, divisor=8):
+    c = c * multiplier
+    rounded = max(divisor, int(c + divisor / 2) // divisor * divisor)
+    if rounded < 0.9 * c:
+        rounded += divisor
+    return int(rounded)
+
+
+class InvertedResidual(nn.Module):
+    out_channels: int
+    stride: int
+    expansion: int
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        dtype = jnp.dtype(self.dtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not training,
+            momentum=0.9,
+            dtype=jnp.float32,
+        )
+        in_channels = x.shape[-1]
+        hidden = in_channels * self.expansion
+        y = x
+        if self.expansion != 1:
+            y = nn.Conv(
+                hidden, (1, 1), use_bias=False, dtype=dtype
+            )(y)
+            y = nn.relu6(norm()(y).astype(dtype))
+        y = nn.Conv(
+            hidden,
+            (3, 3),
+            strides=(self.stride, self.stride),
+            padding="SAME",
+            feature_group_count=hidden,
+            use_bias=False,
+            dtype=dtype,
+        )(y)
+        y = nn.relu6(norm()(y).astype(dtype))
+        y = nn.Conv(
+            self.out_channels, (1, 1), use_bias=False, dtype=dtype
+        )(y)
+        y = norm()(y).astype(dtype)
+        if self.stride == 1 and in_channels == self.out_channels:
+            y = y + x
+        return y
+
+
+class MobileNetV2(nn.Module):
+    num_classes: int = 1000
+    width_multiplier: float = 1.0
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        dtype = jnp.dtype(self.dtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not training,
+            momentum=0.9,
+            dtype=jnp.float32,
+        )
+        x = x.astype(dtype)
+        x = nn.Conv(
+            _round_channels(32, self.width_multiplier),
+            (3, 3),
+            strides=(2, 2),
+            padding="SAME",
+            use_bias=False,
+            dtype=dtype,
+        )(x)
+        x = nn.relu6(norm()(x).astype(dtype))
+        for t, c, n, s in _BLOCKS:
+            channels = _round_channels(c, self.width_multiplier)
+            for i in range(n):
+                x = InvertedResidual(
+                    out_channels=channels,
+                    stride=s if i == 0 else 1,
+                    expansion=t,
+                    dtype=self.dtype,
+                )(x, training=training)
+        head = _round_channels(
+            1280, max(1.0, self.width_multiplier)
+        )
+        x = nn.Conv(head, (1, 1), use_bias=False, dtype=dtype)(x)
+        x = nn.relu6(norm()(x).astype(dtype))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def custom_model():
+    return MobileNetV2()
+
+
+def loss(labels, predictions):
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(
+            predictions, labels.reshape(-1)
+        )
+    )
+
+
+def optimizer(lr=0.05):
+    return optimizers.momentum(learning_rate=lr, momentum_value=0.9)
+
+
+def feed(records, mode, metadata):
+    batch = batch_examples(records)
+    features = batch["image"].astype("float32")
+    labels = batch["label"] if mode != Modes.PREDICTION else None
+    return features, labels
+
+
+def eval_metrics_fn():
+    return {"accuracy": accuracy_metric()}

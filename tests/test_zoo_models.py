@@ -149,3 +149,27 @@ def test_deepfm_distributed_with_ps():
     finally:
         for s in servers:
             s.stop()
+
+
+def test_mobilenetv2_builds_and_steps():
+    """MobileNetV2 (reference benchmark model, ftlib_benchmark.md:138-156):
+    one finite step + the expected ~3.5M parameter count."""
+    spec = get_model_spec("elasticdl_tpu.models.mobilenetv2.mobilenetv2")
+    trainer = LocalTrainer(
+        spec.build_model(), spec.loss, spec.build_optimizer_spec()
+    )
+    rng = np.random.default_rng(0)
+    features = rng.normal(size=(2, 64, 64, 3)).astype(np.float32)
+    labels = rng.integers(0, 1000, 2).astype(np.int64)
+    _, _, loss = trainer.train_minibatch(features, labels)
+    assert np.isfinite(loss)
+    import jax
+
+    n_params = sum(
+        int(np.prod(p.shape))
+        for p in jax.tree_util.tree_leaves(
+            trainer.export_variables()["variables"]["params"]
+        )
+    )
+    # MobileNetV2 1.0x has ~3.5M params at 1000 classes.
+    assert 3.0e6 < n_params < 4.0e6, n_params
