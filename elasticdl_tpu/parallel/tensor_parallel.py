@@ -1,0 +1,72 @@
+"""Tensor parallelism for the transformer flagship: GSPMD sharding rules.
+
+No reference counterpart (the reference is DP-only, SURVEY.md §2.10); this
+extends the parallel story beyond DP+sequence parallelism. TPU-first: no
+manual collectives — the Megatron-style layout is expressed purely as
+PartitionSpecs on the param tree (attention heads and the MLP hidden
+dimension column-split over the "model" mesh axis, their consumers
+row-split, vocab split on the embedding/lm head) and `jit` with
+`in_shardings` lets XLA insert the all-reduces over ICI. Composes with
+batch sharding over "data" on the same mesh.
+"""
+
+import re
+
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu.common.pytree_utils import nest_at, walk_dict
+
+# (path regex, spec) — first match wins; default replicated. Param shapes:
+#   qkv/kernel  [D, 3, H, Dh]   heads column-split
+#   qkv/bias       [3, H, Dh]
+#   proj/kernel [D, D]          row-split (input dim = concat of heads)
+#   Dense_0     [D, 4D]         MLP up, column-split
+#   Dense_1     [4D, D]         MLP down, row-split
+#   tok_emb     [V, D]          vocab-split
+#   lm_head     [D, V]          vocab column-split
+_RULES = (
+    (r".*/qkv/kernel$", lambda ax: P(None, None, ax, None)),
+    (r".*/qkv/bias$", lambda ax: P(None, ax, None)),
+    (r".*/proj/kernel$", lambda ax: P(ax, None)),
+    (r".*/Dense_0/kernel$", lambda ax: P(None, ax)),
+    (r".*/Dense_0/bias$", lambda ax: P(ax)),
+    (r".*/Dense_1/kernel$", lambda ax: P(ax, None)),
+    (r"(^|.*/)tok_emb/embedding$", lambda ax: P(ax, None)),
+    (r"(^|.*/)lm_head/kernel$", lambda ax: P(None, ax)),
+    (r"(^|.*/)lm_head/bias$", lambda ax: P(ax)),
+)
+
+
+def transformer_param_specs(params, model_axis="model"):
+    """Param pytree -> matching PartitionSpec pytree (Megatron layout over
+    `model_axis`; everything unmatched — LayerNorms, proj/Dense_1 biases,
+    pos_emb — replicated)."""
+    specs = {}
+    for path, _ in walk_dict(params):
+        joined = "/".join(path)
+        spec = P()
+        for pattern, make in _RULES:
+            if re.match(pattern, joined):
+                spec = make(model_axis)
+                break
+        specs[path] = spec
+    return nest_at(specs)
+
+
+def validate_divisibility(config, model_parallel):
+    """TP requires the split dimensions to divide evenly."""
+    if config.n_heads % model_parallel:
+        raise ValueError(
+            f"n_heads {config.n_heads} not divisible by model-parallel "
+            f"size {model_parallel}"
+        )
+    if (4 * config.d_model) % model_parallel:
+        raise ValueError(
+            f"MLP hidden dim d_model*4 ({4 * config.d_model}) not "
+            f"divisible by model-parallel size {model_parallel}"
+        )
+    if config.vocab % model_parallel:
+        raise ValueError(
+            f"vocab ({config.vocab}) not divisible by model-parallel "
+            f"size {model_parallel}"
+        )

@@ -1,0 +1,58 @@
+"""MembershipManager unit tests: epoch bumps, rank assignment, and the
+register/evict lifecycle the elastic AllReduce path depends on (reference
+rendezvous_server.py:31-110 behaviors)."""
+
+from elasticdl_tpu.master.membership import MembershipManager
+
+
+def test_epoch_bumps_on_every_membership_change():
+    m = MembershipManager()
+    e0 = m.group_id
+    m.register(0, "a:1")
+    m.register(1, "b:1")
+    e2 = m.group_id
+    assert e2 > e0
+    # Re-registering the same (id, host) is a no-op.
+    m.register(1, "b:1")
+    assert m.group_id == e2
+    m.remove_worker(0)
+    assert m.group_id > e2
+    assert m.worker_hosts == ["b:1"]
+    # Removing an unknown worker does not bump the epoch.
+    e3 = m.group_id
+    m.remove_worker(42)
+    assert m.group_id == e3
+
+
+def test_ranks_are_stable_and_dense():
+    m = MembershipManager()
+    for i, host in enumerate(("a:1", "b:1", "c:1")):
+        m.register(i, host)
+    ranks = {}
+    for host in ("a:1", "b:1", "c:1"):
+        rank, world, group, coord, port = m.get_comm_rank(host)
+        ranks[host] = rank
+        assert world == 3
+    assert sorted(ranks.values()) == [0, 1, 2]
+    # Rank 0's host is the coordinator everyone agrees on.
+    coord_of = {
+        host: m.get_comm_rank(host)[3] for host in ranks
+    }
+    assert len(set(coord_of.values())) == 1
+
+
+def test_worker_host_swap_reassigns():
+    """A relaunched worker re-registers with a NEW host (new ephemeral
+    port): the old host leaves, the new one joins, epoch advances."""
+    m = MembershipManager()
+    m.register(0, "a:1")
+    m.register(1, "b:1")
+    before = m.group_id
+    m.register(0, "a:9")  # relaunch
+    assert m.group_id > before
+    assert sorted(m.worker_hosts) == ["a:9", "b:1"]
+    rank, world, *_ = m.get_comm_rank("a:9")
+    assert world == 2 and rank in (0, 1)
+    # The dead host is unknown now.
+    rank, world, *_ = m.get_comm_rank("a:1")
+    assert rank == -1 or "a:1" not in m.worker_hosts

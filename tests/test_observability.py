@@ -81,3 +81,28 @@ def test_get_job_status_rpc():
         )
         status = stub.get_job_status(pb.GetJobStatusRequest())
         assert status.finished and status.records_done == 40
+
+
+def test_metrics_service_metadata_collision(tmp_path):
+    """A user metric named like a record metadata field must not clobber
+    ts/group/step."""
+    ms = MetricsService(str(tmp_path), tensorboard=False)
+    ms.log_scalars("eval", 7, {"step": 0.99, "accuracy": 0.5})
+    ms.close()
+    line = json.loads((tmp_path / "metrics.jsonl").read_text())
+    assert line["step"] == 7  # the model version, not the metric
+    assert line["metric_step"] == 0.99
+    assert line["accuracy"] == 0.5
+
+
+def test_timing_nested_and_exception_safety():
+    t = Timing()
+    try:
+        with t.record("outer"):
+            with t.record("inner"):
+                raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    s = t.summary()
+    # Both phases recorded despite the exception escaping.
+    assert s["outer"]["count"] == 1 and s["inner"]["count"] == 1
