@@ -1,0 +1,116 @@
+"""Switch-style mixture-of-experts FFN with expert parallelism.
+
+No reference counterpart (the reference is DP-only, SURVEY.md §2.10); this
+extends the parallel story with EP. TPU-first design: top-1 routing with
+FIXED capacity so every shape is static under jit — dispatch and combine
+are one-hot einsums (MXU work, no scatter), and the expert weight tensors
+[E, ...] shard over an "expert" mesh axis via plain PartitionSpecs, with
+XLA inserting the all-to-alls. Dropped tokens (over capacity) pass through
+the residual unchanged, the standard Switch behavior.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+class SwitchMoE(nn.Module):
+    """Top-1 routed expert FFN. Returns (output [B, S, D], aux_loss) —
+    aux_loss is the Switch load-balancing term, add it to the task loss
+    scaled by ~1e-2."""
+
+    num_experts: int
+    d_hidden: int
+    capacity_factor: float = 1.25
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = jnp.dtype(self.dtype)
+        b, s, d = x.shape
+        tokens = x.reshape(-1, d)
+        n_tokens = b * s
+        capacity = max(
+            1,
+            int(self.capacity_factor * n_tokens / self.num_experts),
+        )
+
+        # Router in float32: tiny matmul, numerically sensitive.
+        logits = nn.Dense(
+            self.num_experts, dtype=jnp.float32, name="router"
+        )(tokens.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
+        expert = jnp.argmax(probs, axis=-1)  # [T]
+        onehot = jax.nn.one_hot(
+            expert, self.num_experts, dtype=jnp.float32
+        )
+
+        # Load-balancing aux loss (Switch eq. 4): fraction of tokens per
+        # expert dotted with mean router prob per expert, scaled by E.
+        density = jnp.mean(onehot, axis=0)
+        density_proxy = jnp.mean(probs, axis=0)
+        aux_loss = self.num_experts * jnp.sum(density * density_proxy)
+
+        # Position of each token within its expert; beyond-capacity tokens
+        # drop (contribute zero; the caller's residual carries them).
+        position = jnp.cumsum(onehot, axis=0) * onehot  # 1-based
+        keep = (position <= capacity).astype(jnp.float32) * onehot
+        gate = jnp.sum(probs * keep, axis=-1)  # [T]
+        pos_idx = jnp.sum((position - 1.0) * keep, axis=-1).astype(
+            jnp.int32
+        )
+        # [T, E, C] one-hot dispatch mask.
+        dispatch = (
+            keep[:, :, None]
+            * jax.nn.one_hot(pos_idx, capacity, dtype=jnp.float32)[
+                :, None, :
+            ]
+        )
+
+        w_in = self.param(
+            "w_in",
+            nn.initializers.lecun_normal(),
+            (self.num_experts, d, self.d_hidden),
+        )
+        w_out = self.param(
+            "w_out",
+            nn.initializers.lecun_normal(),
+            (self.num_experts, self.d_hidden, d),
+        )
+
+        # Dispatch -> expert FFN -> combine, all einsums (the all-to-alls
+        # appear here when w_*/expert axes are sharded).
+        expert_in = jnp.einsum(
+            "tec,td->ecd", dispatch.astype(dtype), tokens.astype(dtype)
+        )
+        h = nn.gelu(
+            jnp.einsum("ecd,edh->ech", expert_in, w_in.astype(dtype))
+        )
+        expert_out = jnp.einsum(
+            "ech,ehd->ecd", h, w_out.astype(dtype)
+        )
+        combined = jnp.einsum(
+            "tec,ecd->td",
+            (dispatch * gate[:, None, None]).astype(dtype),
+            expert_out,
+        )
+        return combined.reshape(b, s, d).astype(x.dtype), aux_loss
+
+
+def moe_param_specs(params, expert_axis="expert"):
+    """PartitionSpecs for a SwitchMoE param subtree, built by walking the
+    actual tree so structure changes can't silently diverge: expert
+    weight tensors (leading dim E) shard over `expert_axis`, everything
+    else (the router) replicates."""
+    from elasticdl_tpu.common.pytree_utils import nest_at, walk_dict
+
+    specs = {}
+    for path, leaf in walk_dict(params):
+        if path[-1] in ("w_in", "w_out"):
+            specs[path] = P(
+                expert_axis, *([None] * (leaf.ndim - 1))
+            )
+        else:
+            specs[path] = P()
+    return nest_at(specs)
