@@ -24,10 +24,7 @@ def _clear_backends():
 
         clear_backends()
     except Exception:
-        logger.warning(
-            "could not clear XLA backends after leaving world",
-            exc_info=True,
-        )
+        logger.warning("could not clear XLA backends", exc_info=True)
 
 _current = {
     "coordinator": None,
@@ -59,12 +56,11 @@ def ensure_world(coordinator_addr, world_size, rank, epoch=None):
         logger.info("Leaving distributed world %s", _current)
         jax.distributed.shutdown()
         _current["live"] = False
-        # The XLA backend caches the old world's device topology, and
-        # jax.distributed.initialize refuses to run once a backend is
-        # initialized — drop the cached backends so the re-init (elastic
-        # regroup) can rebuild the device set. Compiled functions from the
-        # old world are invalid either way; trainers rebuild their jitted
-        # steps after a regroup.
+        # Drop the cached backends so the old world's device topology
+        # can't leak into world_size<=1 callers; the join path below also
+        # clears unconditionally before re-initializing. Compiled
+        # functions from the old world are invalid either way; trainers
+        # rebuild their jitted steps after a regroup.
         _clear_backends()
     if world_size <= 1:
         _current.update(coordinator=None, world=1, rank=0, epoch=epoch)
@@ -76,6 +72,22 @@ def ensure_world(coordinator_addr, world_size, rank, epoch=None):
         rank,
         epoch,
     )
+    try:
+        # Cross-process CPU collectives need the gloo implementation; a
+        # no-op on TPU deployments.
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        logger.warning(
+            "could not select gloo CPU collectives; cross-process CPU "
+            "worlds may fail",
+            exc_info=True,
+        )
+    # jax.distributed.initialize refuses to run once a backend is
+    # initialized — true both on a FIRST join from a process that already
+    # ran JAX computations (a trainer that built params before discovering
+    # its world) and on a rejoin. Drop any cached backends; callers must
+    # host-snapshot device state BEFORE calling (the trainer does).
+    _clear_backends()
     jax.distributed.initialize(
         coordinator_address=coordinator_addr,
         num_processes=world_size,

@@ -65,11 +65,15 @@ _MH_CHILD = textwrap.dedent(
     sys.path.insert(0, %(repo)r)
     rank, world, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     import jax
-    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
     from elasticdl_tpu.parallel import distributed
+
+    # Touch the backend BEFORE joining, like a trainer that built params
+    # before discovering its world: ensure_world must clear the cached
+    # single-process backend or jax.distributed.initialize refuses.
+    _ = float(jnp.ones(3).sum())
 
     # Membership epoch 1: join the 2-process world.
     distributed.ensure_world(coord, world, rank, epoch=1)
