@@ -37,6 +37,14 @@ def add_common_arguments(parser):
     parser.add_argument("--minibatch_size", type=int, default=64)
     parser.add_argument("--log_loss_steps", type=int, default=100)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--model_parallel_size",
+        type=int,
+        default=1,
+        help="tensor-parallel width for the AllReduce strategy: the device "
+        "mesh gains a 'model' axis of this size and params are laid out by "
+        "the model spec's param_specs(variables) hook (pure DP when 1)",
+    )
 
 
 def add_data_arguments(parser):

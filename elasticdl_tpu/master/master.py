@@ -40,6 +40,7 @@ _WORKER_RELAY_ARGS = [
     "minibatch_size",
     "log_loss_steps",
     "seed",
+    "model_parallel_size",
     "training_data",
     "validation_data",
     "prediction_data",

@@ -114,34 +114,47 @@ class Block(nn.Module):
         return x + h
 
 
+def embed_input(cfg, tokens):
+    """Token + positional embedding. A plain function (not a submodule) so
+    both TransformerLM and the pipelined build (parallel/pipeline.py) share
+    one implementation without changing either's param tree — flax registers
+    the named submodules on whichever module's compact scope is active."""
+    dtype = jnp.dtype(cfg.activation_dtype)
+    s = tokens.shape[1]
+    if s > cfg.max_len:
+        # Without this, the positional gather would silently clamp
+        # out-of-range indices under XLA and corrupt positions.
+        raise ValueError(
+            f"sequence length {s} exceeds max_len {cfg.max_len}"
+        )
+    x = nn.Embed(cfg.vocab, cfg.d_model, dtype=dtype, name="tok_emb")(
+        tokens.astype(jnp.int32)
+    )
+    pos = nn.Embed(cfg.max_len, cfg.d_model, dtype=dtype,
+                   name="pos_emb")(jnp.arange(s))
+    return x + pos[None]
+
+
+def head_output(cfg, x):
+    """Final LayerNorm + LM head; shared with the pipelined build (see
+    embed_input). Logits in float32: softmax/CE stay out of bfloat16."""
+    x = nn.LayerNorm(dtype=jnp.dtype(cfg.activation_dtype))(x)
+    return nn.Dense(cfg.vocab, dtype=jnp.float32, name="lm_head")(x)
+
+
 class TransformerLM(nn.Module):
     config: LMConfig = LMConfig()
 
     @nn.compact
     def __call__(self, tokens, training: bool = False):
         cfg = self.config
-        dtype = jnp.dtype(cfg.activation_dtype)
-        s = tokens.shape[1]
-        if s > cfg.max_len:
-            # Without this, the positional gather would silently clamp
-            # out-of-range indices under XLA and corrupt positions.
-            raise ValueError(
-                f"sequence length {s} exceeds max_len {cfg.max_len}"
-            )
-        x = nn.Embed(cfg.vocab, cfg.d_model, dtype=dtype, name="tok_emb")(
-            tokens.astype(jnp.int32)
-        )
-        pos = nn.Embed(cfg.max_len, cfg.d_model, dtype=dtype,
-                       name="pos_emb")(jnp.arange(s))
-        x = x + pos[None]
+        x = embed_input(cfg, tokens)
         block_cls = (
             nn.remat(Block, static_argnums=(2,)) if cfg.remat else Block
         )
         for _ in range(cfg.n_layers):
             x = block_cls(cfg)(x, training)
-        x = nn.LayerNorm(dtype=dtype)(x)
-        # Logits in float32: softmax/CE stay out of bfloat16.
-        return nn.Dense(cfg.vocab, dtype=jnp.float32, name="lm_head")(x)
+        return head_output(cfg, x)
 
 
 # ---------- model spec contract ----------
@@ -170,6 +183,27 @@ def feed(records, mode, metadata):
     features = tokens[:, :-1]
     labels = tokens[:, 1:] if mode != Modes.PREDICTION else None
     return features, labels
+
+
+def param_specs(variables):
+    """Model-spec hook for hybrid DP x TP (worker --model_parallel_size):
+    Megatron-style PartitionSpecs over the "model" mesh axis for the param
+    collection, everything else (batch stats etc.) replicated."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from elasticdl_tpu.parallel.tensor_parallel import (
+        transformer_param_specs,
+    )
+
+    return {
+        k: (
+            transformer_param_specs(v)
+            if k == "params"
+            else jax.tree_util.tree_map(lambda _: P(), v)
+        )
+        for k, v in variables.items()
+    }
 
 
 def eval_metrics_fn():

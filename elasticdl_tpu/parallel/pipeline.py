@@ -1,0 +1,307 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh axis.
+
+Capability extension beyond the reference (which is DP-only; SURVEY.md §2.10
+records no TP/PP/SP/EP anywhere upstream). TPU-first design: per-stage
+parameters are STACKED along a leading axis and sharded over the "stage"
+mesh axis, so each device owns exactly one stage's weights. The whole
+pipeline — fills, steady state, and drain — is ONE `lax.scan` over
+`num_microbatches + num_stages - 1` ticks inside `shard_map`: at every tick
+each device runs its stage on the activation received from its neighbor on
+the previous tick (`lax.ppermute` ring shift), stage 0 feeding fresh
+microbatches and the last stage banking finished ones. Differentiating
+through the scan + ppermute yields the mirrored backward schedule
+automatically, and XLA compiles the full fwd+bwd pipeline (bubble included)
+into a single SPMD program whose stage hops ride ICI.
+
+Why this shape and not a Python loop of per-stage jits: under jit the scan
+is traced once with static shapes, collectives are neighbor-only
+ppermutes (no host round-trips between microbatches), and the bubble cost
+is the schedule's only overhead — (N-1)/(M+N-1) of ticks idle per device,
+amortized by raising M.
+
+Memory: scan autodiff saves each tick's activations; with `remat=True` the
+stage body is wrapped in `jax.checkpoint`, storing only the inter-stage
+activations (O(M) per device) and recomputing block internals — the same
+recipe the flagship LM uses for long context.
+
+Composes with data parallelism: on a ("data", "stage") mesh the microbatch
+batch dim is sharded over "data" while params shard over "stage"; every
+collective here names only the stage axis.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def pipeline_apply(stage_fn, stage_params, x_micro, axis_name="stage",
+                   rng=None, batch_axis=None):
+    """Run microbatches through the pipeline. Call INSIDE shard_map.
+
+    stage_fn: (params_for_one_stage, x_microbatch) -> y_microbatch, with
+      output shaped like the input (the inter-stage activation contract).
+      When `rng` is given, called as (params, x, tick_rng) instead, with
+      tick_rng distinct per (stage, tick, data-shard) — fold_in of the
+      stage index, tick counter, and (when `batch_axis` names a DP mesh
+      axis) the data-shard index — so stochastic layers (dropout) draw
+      independent bits per stage, microbatch, and batch shard.
+    stage_params: pytree whose leaves have a leading stage axis; sharded
+      over `axis_name`, so inside shard_map the local leading dim is 1.
+    x_micro: [M, mb, ...] microbatched input, replicated over `axis_name`.
+    Returns [M, mb, ...] outputs, replicated over `axis_name` (the last
+    stage's results are broadcast with a masked psum).
+    """
+    n_stages = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    params_local = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+    num_micro = x_micro.shape[0]
+    ticks = num_micro + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        state, outputs = carry
+        # Stage 0 consumes fresh microbatch t during the fill; other
+        # stages consume what arrived from their neighbor last tick.
+        fresh = jax.lax.dynamic_index_in_dim(
+            x_micro, jnp.minimum(t, num_micro - 1), 0, keepdims=False
+        )
+        inp = jnp.where(stage == 0, fresh, state)
+        if rng is None:
+            out = stage_fn(params_local, inp)
+        else:
+            tick_rng = jax.random.fold_in(
+                jax.random.fold_in(rng, stage), t
+            )
+            if batch_axis is not None:
+                # rng enters shard_map replicated; without this fold the
+                # same dropout mask would repeat across every DP shard.
+                tick_rng = jax.random.fold_in(
+                    tick_rng, jax.lax.axis_index(batch_axis)
+                )
+            out = stage_fn(params_local, inp, tick_rng)
+        # The last stage banks microbatch t-(N-1) once the pipe is full.
+        out_idx = t - (n_stages - 1)
+        bank = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+        safe = jnp.clip(out_idx, 0, num_micro - 1)
+        cur = jax.lax.dynamic_index_in_dim(outputs, safe, 0,
+                                           keepdims=False)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(bank, out, cur), safe, 0
+        )
+        state = jax.lax.ppermute(out, axis_name, perm)
+        return (state, outputs), None
+
+    state0 = jnp.zeros_like(x_micro[0])
+    outputs0 = jnp.zeros_like(x_micro)
+    (_, outputs), _ = jax.lax.scan(
+        tick, (state0, outputs0), jnp.arange(ticks)
+    )
+    # Broadcast the last stage's banked outputs to every stage so the
+    # result is replicated over the pipeline axis.
+    return jax.lax.psum(
+        jnp.where(stage == n_stages - 1, outputs, 0), axis_name
+    )
+
+
+def make_pipeline(stage_fn, mesh, axis_name="stage", batch_axis=None,
+                  remat=False):
+    """shard_map-wrapped pipeline: takes GLOBAL (stage_params, x_micro)
+    with params stacked [n_stages, ...] (sharded over `axis_name`) and
+    x_micro [M, mb, ...] (optionally sharded over `batch_axis` on mb for
+    DP x PP meshes); returns [M, mb, ...] outputs with x's sharding."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
+    x_spec = P(None, batch_axis)
+
+    def _validate(stage_params, x_micro):
+        # Fail with actionable messages instead of shard_map internals.
+        n_stages = mesh.shape[axis_name]
+        lead = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+        if lead != n_stages:
+            raise ValueError(
+                f"stage_params leading dim {lead} != mesh axis "
+                f"{axis_name!r} size {n_stages}"
+            )
+        if batch_axis is not None:
+            dp = mesh.shape[batch_axis]
+            mb = x_micro.shape[1]
+            if mb % dp:
+                raise ValueError(
+                    f"microbatch size {mb} not divisible by "
+                    f"{batch_axis!r} axis size {dp}; adjust the batch "
+                    f"size or num_microbatches"
+                )
+
+    def wrapper(stage_params, x_micro, rng=None):
+        _validate(stage_params, x_micro)
+        p_specs = jax.tree_util.tree_map(
+            lambda _: P(axis_name), stage_params
+        )
+        if rng is None:
+            def run(stage_params, x_micro):
+                return pipeline_apply(
+                    stage_fn, stage_params, x_micro, axis_name=axis_name
+                )
+
+            return shard_map(
+                run,
+                mesh=mesh,
+                in_specs=(p_specs, x_spec),
+                out_specs=x_spec,
+                check_vma=False,
+            )(stage_params, x_micro)
+
+        def run_rng(stage_params, x_micro, rng):
+            return pipeline_apply(
+                stage_fn, stage_params, x_micro, axis_name=axis_name,
+                rng=rng, batch_axis=batch_axis,
+            )
+
+        return shard_map(
+            run_rng,
+            mesh=mesh,
+            in_specs=(p_specs, x_spec, P()),
+            out_specs=x_spec,
+            check_vma=False,
+        )(stage_params, x_micro, rng)
+
+    return wrapper
+
+
+def microbatch(x, num_microbatches):
+    """[B, ...] -> [M, B//M, ...]; B must divide evenly."""
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible by {num_microbatches} microbatches"
+        )
+    return x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+
+
+def unmicrobatch(y):
+    """[M, mb, ...] -> [M*mb, ...]."""
+    return y.reshape(y.shape[0] * y.shape[1], *y.shape[2:])
+
+
+def stack_stage_params(per_stage):
+    """List of per-stage param pytrees -> one pytree with a leading stage
+    axis (what pipeline_apply expects, sharded P('stage', ...))."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage
+    )
+
+
+# ---------- pipelined transformer LM (flagship integration) ----------
+
+
+def make_lm_pipeline(cfg, mesh, n_stages, num_microbatches,
+                     axis_name="stage", batch_axis=None):
+    """A pipelined build of the flagship transformer LM: embedding and LM
+    head run replicated over the stage axis (they are a small fraction of
+    the FLOPs), the Block stack is split into `n_stages` equal stages and
+    pipelined. Returns (init_fn, apply_fn):
+
+      init_fn(rng, sample_tokens) -> params
+          {"embed": ..., "stages": stacked [n_stages, ...], "head": ...}
+      apply_fn(params, tokens, training=False) -> [B, S, vocab] logits
+    """
+    import flax.linen as nn
+
+    from elasticdl_tpu.models.transformer.transformer_lm import (
+        Block,
+        embed_input,
+        head_output,
+    )
+
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers {cfg.n_layers} not divisible by {n_stages} stages"
+        )
+    layers_per_stage = cfg.n_layers // n_stages
+
+    # Thin module shells around the SAME embed/head implementations the
+    # monolithic TransformerLM uses (transformer_lm.embed_input /
+    # head_output) — the only pipeline-specific structure is the stage
+    # grouping of Blocks.
+    class EmbedIn(nn.Module):
+        @nn.compact
+        def __call__(self, tokens):
+            return embed_input(cfg, tokens)
+
+    class Stage(nn.Module):
+        @nn.compact
+        def __call__(self, x, training=False):
+            for _ in range(layers_per_stage):
+                x = Block(cfg)(x, training)
+            return x
+
+    class HeadOut(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return head_output(cfg, x)
+
+    embed_mod, stage_mod, head_mod = EmbedIn(), Stage(), HeadOut()
+
+    def init_fn(rng, sample_tokens):
+        r_embed, r_stage, r_head = jax.random.split(rng, 3)
+        embed_p = embed_mod.init(r_embed, sample_tokens)["params"]
+        sample_x = embed_mod.apply({"params": embed_p}, sample_tokens)
+        mb = sample_x[: max(1, sample_x.shape[0] // num_microbatches)]
+        stage_rngs = jax.random.split(r_stage, n_stages)
+        stacked = jax.vmap(
+            lambda r: stage_mod.init(r, mb, False)["params"]
+        )(stage_rngs)
+        head_p = head_mod.init(r_head, mb)["params"]
+        return {"embed": embed_p, "stages": stacked, "head": head_p}
+
+    def apply_fn(params, tokens, training=False, rngs=None):
+        x = embed_mod.apply({"params": params["embed"]}, tokens)
+        x_micro = microbatch(x, num_microbatches)
+        dropout_rng = (rngs or {}).get("dropout")
+        need_rng = bool(cfg.dropout) and training
+        if need_rng and dropout_rng is None:
+            raise ValueError(
+                "training with cfg.dropout > 0 requires "
+                "rngs={'dropout': key} (per-stage/tick keys are derived "
+                "inside the pipeline)"
+            )
+        if need_rng:
+            def stage_fn(p, xm, r):
+                return stage_mod.apply(
+                    {"params": p}, xm, training, rngs={"dropout": r}
+                )
+        else:
+            def stage_fn(p, xm):
+                return stage_mod.apply({"params": p}, xm, training)
+
+        pipe = make_pipeline(
+            stage_fn, mesh, axis_name=axis_name, batch_axis=batch_axis,
+            remat=cfg.remat,
+        )
+        y = unmicrobatch(
+            pipe(params["stages"], x_micro, dropout_rng)
+            if need_rng
+            else pipe(params["stages"], x_micro)
+        )
+        return head_mod.apply({"params": params["head"]}, y)
+
+    return init_fn, apply_fn
+
+
+def lm_pipeline_param_specs(params, axis_name="stage"):
+    """PartitionSpecs for make_lm_pipeline params: stages sharded over the
+    pipeline axis on their stacked leading dim, embed/head replicated —
+    feed through NamedSharding for jit in_shardings."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "embed": jax.tree_util.tree_map(lambda _: P(), params["embed"]),
+        "stages": jax.tree_util.tree_map(
+            lambda _: P(axis_name), params["stages"]
+        ),
+        "head": jax.tree_util.tree_map(lambda _: P(), params["head"]),
+    }

@@ -19,6 +19,17 @@ rendezvous manager. TPU-first redesign:
   (parallel/broadcast.py) and overwrite local state.
 - Comm failures retry with re-init, up to `max_comm_retries` (reference
   retries <=5 on Horovod UnknownError, allreduce_trainer.py:125-139).
+- Hybrid DP x TP (extension; the reference is DP-only): with
+  `model_parallel_size > 1` and a model-spec `param_specs(variables)` hook
+  (e.g. parallel/tensor_parallel.transformer_param_specs), the mesh gains a
+  "model" axis and parameters are laid out by those PartitionSpecs instead
+  of replicated — XLA inserts the Megatron-style collectives. Optimizer
+  state is left to GSPMD sharding propagation (it mirrors the param layout
+  after the first step). If an elastic world change leaves the device count
+  indivisible by the model-parallel size, the trainer falls back to pure DP
+  for that epoch rather than failing the job. TP is single-host only
+  (multi-host TP is rejected at construction: cross-process param shards
+  would break the rank-0 state broadcast).
 """
 
 import threading
@@ -65,8 +76,28 @@ class AllReduceTrainer(JaxTrainer):
         multi_host=False,
         broadcast_port=0,
         seed=0,
+        model_parallel_size=1,
+        param_specs_fn=None,
     ):
         super().__init__(model, loss_fn, optimizer_spec, seed=seed)
+        self._model_parallel_size = max(1, int(model_parallel_size or 1))
+        self._param_specs_fn = param_specs_fn
+        if multi_host and self._model_parallel_size > 1:
+            # Multi-host TP would shard params across processes, making
+            # them non-fully-addressable — the host-side state snapshot
+            # that backs rank-0 broadcast (_state_provider) cannot
+            # device_get such arrays, so every elastic regroup would
+            # silently discard progress. Gathering inside the snapshot is
+            # a collective and _state_provider runs on rank 0's gRPC
+            # thread alone, so it cannot be done there. Refuse loudly
+            # until the broadcast path grows a sharded-pull protocol.
+            raise ValueError(
+                "model_parallel_size > 1 is not supported with "
+                "multi_host=True: params sharded across processes break "
+                "the rank-0 state broadcast. Run TP within one host "
+                "(single process, multiple chips) or use pure DP "
+                "across hosts."
+            )
         self._step_rng_base = jax.random.fold_in(
             jax.random.PRNGKey(seed), 0x5EED
         )
@@ -163,7 +194,7 @@ class AllReduceTrainer(JaxTrainer):
                 resp.rank_id,
                 epoch=resp.rendezvous_id,
             )
-        self._mesh = make_mesh()
+        self._mesh = self._make_world_mesh()
         self._sharded_steps = {}
         if self._rank != 0 and resp.coordinator_addr:
             pulled = self._pull_from_rank0(resp.coordinator_addr)
@@ -173,7 +204,9 @@ class AllReduceTrainer(JaxTrainer):
             variables, opt_state, version = host_state
             repl = replicated_sharding(self._mesh)
             with self._state_lock:
-                self._variables = jax.device_put(variables, repl)
+                self._variables = jax.device_put(
+                    variables, self._variables_sharding(variables)
+                )
                 self._opt_state = jax.device_put(opt_state, repl)
                 self._version = version
         elif self._variables is not None:
@@ -215,6 +248,122 @@ class AllReduceTrainer(JaxTrainer):
             )
         return state
 
+    # ---------- mesh / sharding layout ----------
+
+    def _make_world_mesh(self):
+        mp = self._model_parallel_size
+        n = len(jax.devices())
+        if mp > 1 and self._param_specs_fn is None:
+            # A model axis without param layouts would just duplicate the
+            # same DP computation mp times — half (or worse) of the
+            # cluster doing redundant work. Take the DP fallback instead.
+            logger.warning(
+                "model_parallel_size %d requested but the model spec has "
+                "no param_specs hook; falling back to pure data "
+                "parallelism", mp,
+            )
+        elif mp > 1 and n % mp != 0:
+            logger.warning(
+                "model_parallel_size %d does not divide %d devices; "
+                "falling back to pure data parallelism for this world",
+                mp, n,
+            )
+        elif mp > 1:
+            bad = (
+                self._spec_violations(self._variables, mp)
+                if self._variables is not None
+                else []
+            )
+            if bad:
+                # Keeping a (data=n/mp, model=mp) mesh with replicated
+                # params would silently run mp-way duplicated compute;
+                # rebuild a genuine pure-DP mesh instead.
+                logger.warning(
+                    "param_specs incompatible with model_parallel_size "
+                    "%d (%s); falling back to pure data parallelism",
+                    mp, "; ".join(bad[:3]),
+                )
+            else:
+                from elasticdl_tpu.parallel.mesh import (
+                    DATA_AXIS,
+                    MODEL_AXIS,
+                )
+
+                return make_mesh({DATA_AXIS: -1, MODEL_AXIS: mp})
+        return make_mesh()
+
+    def _spec_violations(self, variables, mp):
+        """Sharded dims that don't divide the model-axis size, as human
+        messages ([] = layout is valid). Checked before mesh construction
+        so misconfiguration degrades to DP instead of dying in jax
+        internals with an opaque device_put ValueError."""
+        from jax.sharding import PartitionSpec
+
+        specs = self._param_specs_fn(variables)
+        sizes = {"model": mp}
+        bad = []
+
+        def _check(path, v, s):
+            ndim = len(getattr(v, "shape", ()))
+            if len(s) > ndim:
+                bad.append(
+                    f"{'/'.join(str(p) for p in path)}: spec rank "
+                    f"{len(s)} exceeds param rank {ndim}"
+                )
+                return
+            for i, axes in enumerate(s):
+                if axes is None:
+                    continue
+                names = axes if isinstance(axes, tuple) else (axes,)
+                size = int(
+                    np.prod([sizes.get(a, 1) for a in names])
+                )
+                if size > 1 and v.shape[i] % size:
+                    bad.append(
+                        f"{'/'.join(str(p) for p in path)}: dim {i} "
+                        f"({v.shape[i]}) % {size} != 0"
+                    )
+
+        jax.tree_util.tree_map_with_path(
+            lambda p, v, s: _check(p, v, s), variables, specs,
+            is_leaf=lambda v: isinstance(v, PartitionSpec),
+        )
+        return bad
+
+    def _tp_active(self):
+        return (
+            self._param_specs_fn is not None
+            and "model" in self._mesh.shape
+            and self._mesh.shape["model"] > 1
+        )
+
+    def _variables_sharding(self, variables):
+        """NamedSharding layout for the variables pytree: the model-spec's
+        param_specs when running TP, else replicated."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if not self._tp_active():
+            return replicated_sharding(self._mesh)
+        # Safety net for the rare path where the mesh was built before
+        # variables existed: replicate rather than die in device_put.
+        # (_make_world_mesh normally rebuilds a pure-DP mesh instead.)
+        bad = self._spec_violations(
+            variables, self._mesh.shape["model"]
+        )
+        if bad:
+            logger.warning(
+                "param_specs incompatible with the current mesh (%s); "
+                "replicating params on it — the model axis duplicates "
+                "compute until the next world change rebuilds a DP mesh",
+                "; ".join(bad[:3]),
+            )
+            return replicated_sharding(self._mesh)
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(self._mesh, s),
+            self._param_specs_fn(variables),
+            is_leaf=lambda v: isinstance(v, PartitionSpec),
+        )
+
     # ---------- sharded step ----------
 
     def _sharded_step_for(self, real_n, padded_n):
@@ -246,10 +395,17 @@ class AllReduceTrainer(JaxTrainer):
             # failure mid-step must leave (variables, opt_state) intact for
             # the retry/re-mesh path — donated buffers would already be
             # invalidated when the except branch snapshots state.
+            # Under TP, optimizer-state shardings are deliberately
+            # unconstrained (None): GSPMD propagation reshards mu/nu to
+            # mirror the param layout after the first step (one extra
+            # compile when the inferred layout differs from the initial
+            # replicated placement).
+            var_sh = self._variables_sharding(self._variables)
+            opt_sh = None if self._tp_active() else repl
             step = jax.jit(
                 step_fn,
-                in_shardings=(repl, repl, repl, data, data),
-                out_shardings=(repl, repl, repl),
+                in_shardings=(var_sh, opt_sh, repl, data, data),
+                out_shardings=(var_sh, opt_sh, repl),
             )
             self._sharded_steps[key] = step
         return step
@@ -263,7 +419,9 @@ class AllReduceTrainer(JaxTrainer):
             self.init_world_if_needed(force=True)
         elif first_init:
             repl = replicated_sharding(self._mesh)
-            self._variables = jax.device_put(self._variables, repl)
+            self._variables = jax.device_put(
+                self._variables, self._variables_sharding(self._variables)
+            )
             self._opt_state = jax.device_put(self._opt_state, repl)
 
     def train_minibatch(self, features, labels):

@@ -52,6 +52,8 @@ def build_trainer(args, spec, master_client):
             master_client,
             multi_host=args.multi_host,
             seed=args.seed,
+            model_parallel_size=args.model_parallel_size,
+            param_specs_fn=getattr(spec.module, "param_specs", None),
         )
     from elasticdl_tpu.worker.trainer import LocalTrainer
 
