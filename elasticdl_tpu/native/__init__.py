@@ -59,6 +59,13 @@ def _declare(lib):
         fn = getattr(lib, name)
         fn.argtypes = argtypes
         fn.restype = None
+    # Record-file reader (recordio.cc) returns byte counts / error codes.
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.edl_records_read.argtypes = [
+        ctypes.c_char_p, ctypes.c_longlong, ctypes.c_longlong, u8p,
+        ctypes.c_longlong, i64p,
+    ]
+    lib.edl_records_read.restype = ctypes.c_longlong
     return lib
 
 
@@ -81,9 +88,12 @@ def lib():
             _lib = False
             return None
         try:
-            if not os.path.exists(_SO) or os.path.getmtime(
-                _SO
-            ) < os.path.getmtime(os.path.join(_HERE, "kernels.cc")):
+            sources = ("kernels.cc", "recordio.cc")
+            if not os.path.exists(_SO) or any(
+                os.path.getmtime(_SO)
+                < os.path.getmtime(os.path.join(_HERE, src))
+                for src in sources
+            ):
                 build()
             _lib = _declare(ctypes.CDLL(_SO))
             logger.info("Loaded native kernels from %s", _SO)

@@ -1,0 +1,151 @@
+// Native batch range-reads for .edlr record files (data/recordfile.py).
+//
+// The reference's data plane leans on a native RecordIO library for its
+// range reads (/root/reference/elasticdl/python/data/reader/
+// recordio_reader.py:27-62 over the pyrecordio C extension); this is the
+// equivalent for the .edlr format: one mmap, one sequential scan over the
+// requested record range, CRC32 verification (format v2) and payload
+// copy-out done in C instead of per-record Python struct unpacking.
+//
+// Layout (little-endian; see recordfile.py):
+//   [magic "EDLR"][u32 version]
+//   v1 record: [u32 len][payload]
+//   v2 record: [u32 len][u32 crc32(payload)][payload]
+//   footer: [u64 offset]*num  [u64 num][u64 index_offset][magic "EDLI"]
+//
+// Error codes (negative returns): -1 io/open, -2 corrupt header/footer,
+// -3 range out of bounds, -4 output buffer too small, -5 crc mismatch,
+// -6 unsupported version.
+
+#include <zlib.h>
+
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr long long kErrIo = -1;
+constexpr long long kErrCorrupt = -2;
+constexpr long long kErrRange = -3;
+constexpr long long kErrBuffer = -4;
+constexpr long long kErrCrc = -5;
+constexpr long long kErrVersion = -6;
+
+constexpr size_t kHeaderSize = 8;    // magic + u32 version
+constexpr size_t kFooterTail = 20;   // u64 num + u64 index_offset + magic
+
+struct Mapped {
+  const unsigned char* p = nullptr;
+  size_t n = 0;
+  int fd = -1;
+
+  ~Mapped() {
+    if (p != nullptr) munmap(const_cast<unsigned char*>(p), n);
+    if (fd >= 0) close(fd);
+  }
+};
+
+uint32_t le32(const unsigned char* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;  // targets are little-endian (x86/ARM TPU hosts)
+}
+
+uint64_t le64(const unsigned char* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+
+bool map_file(const char* path, Mapped* m) {
+  m->fd = open(path, O_RDONLY);
+  if (m->fd < 0) return false;
+  struct stat st;
+  if (fstat(m->fd, &st) != 0 || st.st_size < 0) return false;
+  m->n = static_cast<size_t>(st.st_size);
+  if (m->n == 0) return false;
+  void* p = mmap(nullptr, m->n, PROT_READ, MAP_PRIVATE, m->fd, 0);
+  if (p == MAP_FAILED) return false;
+  m->p = static_cast<const unsigned char*>(p);
+  return true;
+}
+
+struct Parsed {
+  uint32_t version;
+  uint64_t num_records;
+  uint64_t index_offset;
+};
+
+long long parse(const Mapped& m, Parsed* out) {
+  if (m.n < kHeaderSize + kFooterTail) return kErrCorrupt;
+  if (std::memcmp(m.p, "EDLR", 4) != 0) return kErrCorrupt;
+  out->version = le32(m.p + 4);
+  if (out->version != 1 && out->version != 2) return kErrVersion;
+  const unsigned char* tail = m.p + m.n - kFooterTail;
+  if (std::memcmp(tail + 16, "EDLI", 4) != 0) return kErrCorrupt;
+  out->num_records = le64(tail);
+  out->index_offset = le64(tail + 8);
+  // The whole offset index must sit between the records and the tail.
+  if (out->index_offset > m.n - kFooterTail ||
+      out->num_records > (m.n - kFooterTail - out->index_offset) / 8) {
+    return kErrCorrupt;
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Copies the payloads of records [start, start+count) contiguously into
+// out_buf (capacity cap bytes) and each payload length into out_lens
+// (count entries). Returns total payload bytes, or a negative error code.
+long long edl_records_read(const char* path, long long start,
+                           long long count, unsigned char* out_buf,
+                           long long cap, long long* out_lens) {
+  if (start < 0 || count < 0) return kErrRange;
+  Mapped m;
+  if (!map_file(path, &m)) return kErrIo;
+  Parsed f;
+  long long rc = parse(m, &f);
+  if (rc < 0) return rc;
+  if (static_cast<uint64_t>(start) + static_cast<uint64_t>(count) >
+      f.num_records) {
+    return kErrRange;
+  }
+  if (count == 0) return 0;
+
+  const unsigned char* index = m.p + f.index_offset;
+  uint64_t off = le64(index + 8 * static_cast<uint64_t>(start));
+  const uint64_t rec_header = (f.version == 2) ? 8 : 4;
+  long long total = 0;
+  for (long long i = 0; i < count; ++i) {
+    // Subtract-form bounds checks: `off + len` could wrap uint64 on a
+    // corrupt index/length and slip past an addition-form comparison.
+    if (off >= f.index_offset || rec_header > f.index_offset - off) {
+      return kErrCorrupt;
+    }
+    uint32_t len = le32(m.p + off);
+    uint32_t want_crc = (f.version == 2) ? le32(m.p + off + 4) : 0;
+    off += rec_header;
+    if (len > f.index_offset - off) return kErrCorrupt;
+    if (f.version == 2) {
+      uint32_t got =
+          static_cast<uint32_t>(crc32(0L, m.p + off, len));
+      if (got != want_crc) return kErrCrc;
+    }
+    if (total + static_cast<long long>(len) > cap) return kErrBuffer;
+    std::memcpy(out_buf + total, m.p + off, len);
+    out_lens[i] = static_cast<long long>(len);
+    total += len;
+    off += len;
+  }
+  return total;
+}
+
+}  // extern "C"

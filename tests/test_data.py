@@ -121,3 +121,91 @@ def test_single_edlr_file_shards_only_itself(tmp_path):
     assert list(single.create_shards().values()) == [(0, 5)]
     both = create_data_reader(str(tmp_path))
     assert sorted(both.create_shards().values()) == [(0, 5), (0, 7)]
+
+
+# ---------- v2 CRC + native fast path ----------
+
+
+def _write_v1(path, records):
+    """Hand-roll a version-1 file (no CRC) for back-compat coverage."""
+    import struct
+
+    with open(path, "wb") as f:
+        f.write(b"EDLR" + struct.pack("<I", 1))
+        offsets = []
+        for r in records:
+            offsets.append(f.tell())
+            f.write(struct.pack("<I", len(r)) + r)
+        index_offset = f.tell()
+        for off in offsets:
+            f.write(struct.pack("<Q", off))
+        f.write(struct.pack("<QQ4s", len(offsets), index_offset, b"EDLI"))
+
+
+def test_recordfile_native_matches_python(tmp_path, monkeypatch):
+    from elasticdl_tpu import native
+
+    if native.lib() is None:
+        pytest.skip("native library unavailable")
+    path = str(tmp_path / "a.edlr")
+    rng = np.random.default_rng(0)
+    records = [
+        bytes(rng.integers(0, 256, size=rng.integers(0, 400), dtype=np.uint8))
+        for _ in range(50)
+    ]
+    write_records(path, records)
+    with RecordFile(path) as rf:
+        fast = [list(rf.read(s, c)) for s, c in [(0, 50), (10, 5), (49, 1)]]
+    monkeypatch.setenv("EDL_NO_NATIVE", "1")
+    with RecordFile(path) as rf:
+        slow = [list(rf.read(s, c)) for s, c in [(0, 50), (10, 5), (49, 1)]]
+    assert fast == slow
+    assert fast[0] == records
+
+
+def test_recordfile_crc_detects_corruption(tmp_path, monkeypatch):
+    path = str(tmp_path / "a.edlr")
+    write_records(path, [b"A" * 64, b"B" * 64])
+    data = bytearray(open(path, "rb").read())
+    # Flip a byte inside the SECOND record's payload (header 8B + payload).
+    data[8 + 8 + 64 + 8 + 10] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+    # Both the native and the pure-Python reader must catch it.
+    with RecordFile(path) as rf:
+        assert list(rf.read(0, 1)) == [b"A" * 64]  # first record intact
+        with pytest.raises(ValueError, match="CRC"):
+            list(rf.read(0, 2))
+    monkeypatch.setenv("EDL_NO_NATIVE", "1")
+    with RecordFile(path) as rf:
+        with pytest.raises(ValueError, match="CRC"):
+            list(rf.read(1, 1))
+
+
+def test_recordfile_reads_v1_files(tmp_path, monkeypatch):
+    path = str(tmp_path / "v1.edlr")
+    records = [f"old-{i}".encode() for i in range(7)]
+    _write_v1(path, records)
+    with RecordFile(path) as rf:
+        assert rf.num_records == 7
+        assert list(rf.read(2, 3)) == records[2:5]
+    monkeypatch.setenv("EDL_NO_NATIVE", "1")
+    with RecordFile(path) as rf:
+        assert list(rf.read(0, 7)) == records
+
+
+def test_recordfile_corrupt_index_is_error_not_crash(tmp_path):
+    """A corrupted footer index entry (huge offset) must surface as a
+    ValueError from the native scanner, not an out-of-bounds read."""
+    import struct
+
+    path = str(tmp_path / "a.edlr")
+    write_records(path, [b"A" * 32, b"B" * 32])
+    data = bytearray(open(path, "rb").read())
+    # Footer layout: ... [u64 off]*2 [u64 num][u64 index_off][magic].
+    # Smash record 1's index entry with a near-UINT64_MAX offset.
+    idx_entry = len(data) - 20 - 8
+    data[idx_entry:idx_entry + 8] = struct.pack("<Q", 2**64 - 8)
+    open(path, "wb").write(bytes(data))
+    with RecordFile(path) as rf:
+        with pytest.raises(ValueError):
+            list(rf.read(1, 1))
