@@ -16,6 +16,7 @@ import dataclasses
 from typing import Callable, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -49,12 +50,34 @@ class LMConfig:
     # for O(layers) instead of O(layers x activations) memory — the standard
     # long-context recipe (jax.checkpoint).
     remat: bool = False
+    # Name of a jax.checkpoint_policies policy refining WHAT remat saves
+    # (None = recompute everything). "dots_with_no_batch_dims_saveable"
+    # keeps matmul outputs resident so the backward pass skips re-running
+    # the MXU-heavy projections — spends HBM to win step time when the
+    # activations still fit.
+    remat_policy: Optional[str] = None
+
+    def __post_init__(self):
+        if self.remat_policy is not None:
+            if not self.remat:
+                raise ValueError(
+                    "remat_policy is set but remat=False — the policy "
+                    "would be silently ignored; enable remat or drop the "
+                    "policy"
+                )
+            if not hasattr(jax.checkpoint_policies, self.remat_policy):
+                raise ValueError(
+                    f"unknown remat_policy {self.remat_policy!r} (see "
+                    f"jax.checkpoint_policies)"
+                )
 
 
 def flagship_config(max_len: int = 4096) -> "LMConfig":
     """The >=100M-param long-context config validated on a real chip
     (tools/validate_flagship.py): 151M transformer params + 34M embeddings,
-    head_dim 128 (the fast Pallas flash-attention tile), remat on."""
+    head_dim 128 (the fast Pallas flash-attention tile), remat with matmul
+    outputs saved (+6% tokens/sec vs full recompute on TPU v5e —
+    FLAGSHIP_VALIDATION.json: 61.4k tok/s at batch 4, S=4096)."""
     return LMConfig(
         vocab=32768,
         d_model=1024,
@@ -62,6 +85,7 @@ def flagship_config(max_len: int = 4096) -> "LMConfig":
         n_layers=12,
         max_len=max_len,
         remat=True,
+        remat_policy="dots_with_no_batch_dims_saveable",
     )
 
 
@@ -149,9 +173,15 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens, training: bool = False):
         cfg = self.config
         x = embed_input(cfg, tokens)
-        block_cls = (
-            nn.remat(Block, static_argnums=(2,)) if cfg.remat else Block
-        )
+        if cfg.remat:
+            kwargs = {"static_argnums": (2,)}
+            if cfg.remat_policy:
+                kwargs["policy"] = getattr(
+                    jax.checkpoint_policies, cfg.remat_policy
+                )
+            block_cls = nn.remat(Block, **kwargs)
+        else:
+            block_cls = Block
         for _ in range(cfg.n_layers):
             x = block_cls(cfg)(x, training)
         return head_output(cfg, x)
@@ -189,7 +219,6 @@ def param_specs(variables):
     """Model-spec hook for hybrid DP x TP (worker --model_parallel_size):
     Megatron-style PartitionSpecs over the "model" mesh axis for the param
     collection, everything else (batch stats etc.) replicated."""
-    import jax
     from jax.sharding import PartitionSpec as P
 
     from elasticdl_tpu.parallel.tensor_parallel import (

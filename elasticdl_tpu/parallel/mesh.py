@@ -26,6 +26,7 @@ def make_mesh(axis_sizes=None, devices=None) -> Mesh:
     axis_sizes: ordered {axis_name: size} dict; a single size of -1 (or a
     missing remainder) absorbs all remaining devices. Default: 1-D data mesh.
     """
+    explicit_devices = devices is not None
     if devices is None:
         devices = jax.devices()
     devices = np.asarray(devices)
@@ -49,7 +50,26 @@ def make_mesh(axis_sizes=None, devices=None) -> Mesh:
             f"mesh {dict(zip(names, sizes))} wants {total} devices, "
             f"only {len(devices)} visible"
         )
-    return Mesh(devices[:total].reshape(sizes), axis_names=names)
+    chosen = devices[:total]
+    if not explicit_devices and total == len(devices):
+        # Let mesh_utils lay the logical axes onto the physical ICI
+        # topology (torus-neighbor rings per axis) instead of a flat
+        # device-id reshape — on real multi-chip slices this is the
+        # difference between collectives riding nearest-neighbor ICI
+        # links and hopping across the torus. Only when the caller did
+        # not pass an explicit device list (mesh_utils reorders, which
+        # would silently discard a deliberate ordering); falls back to
+        # the plain reshape off-TPU or for partial meshes.
+        try:
+            from jax.experimental import mesh_utils
+
+            arr = mesh_utils.create_device_mesh(
+                tuple(sizes), devices=list(chosen)
+            )
+            return Mesh(arr, axis_names=names)
+        except Exception:
+            pass
+    return Mesh(chosen.reshape(sizes), axis_names=names)
 
 
 def data_sharding(mesh: Mesh, axis=DATA_AXIS) -> NamedSharding:

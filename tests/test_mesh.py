@@ -1,0 +1,50 @@
+"""Mesh construction: axis-size resolution, -1 fill, device subsets, and
+the mesh_utils physical-topology path staying shape-correct."""
+
+import jax
+import numpy as np
+import pytest
+
+from elasticdl_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    make_mesh,
+    pad_batch_to_multiple,
+)
+
+
+def test_default_mesh_is_1d_data():
+    m = make_mesh()
+    assert dict(m.shape) == {"data": 8}
+
+
+def test_fill_axis_and_2d():
+    m = make_mesh({DATA_AXIS: -1, MODEL_AXIS: 2})
+    assert dict(m.shape) == {"data": 4, "model": 2}
+    assert m.devices.shape == (4, 2)
+    # All 8 devices present exactly once regardless of topology layout.
+    ids = sorted(d.id for d in m.devices.flat)
+    assert ids == sorted(d.id for d in jax.devices())
+
+
+def test_device_subset_uses_plain_reshape():
+    m = make_mesh({DATA_AXIS: 2}, devices=jax.devices()[:4])
+    assert dict(m.shape) == {"data": 2}
+
+
+def test_mesh_validation():
+    with pytest.raises(ValueError, match="-1"):
+        make_mesh({DATA_AXIS: -1, MODEL_AXIS: -1})
+    with pytest.raises(ValueError, match="divisible"):
+        make_mesh({DATA_AXIS: -1, MODEL_AXIS: 3})
+    with pytest.raises(ValueError, match="wants"):
+        make_mesh({DATA_AXIS: 16})
+
+
+def test_pad_batch_cyclic():
+    batch = {"x": np.arange(5)}
+    padded, real = pad_batch_to_multiple(batch, 4)
+    assert real == 5
+    np.testing.assert_array_equal(
+        padded["x"], [0, 1, 2, 3, 4, 0, 1, 2]
+    )

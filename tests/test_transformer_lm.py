@@ -36,3 +36,15 @@ def test_dryrun_multichip_dp_sp():
     import __graft_entry__ as graft
 
     graft.dryrun_multichip(8)
+
+
+def test_remat_policy_validation():
+    import pytest
+
+    from elasticdl_tpu.models.transformer.transformer_lm import LMConfig
+
+    with pytest.raises(ValueError, match="remat=False"):
+        LMConfig(remat_policy="dots_with_no_batch_dims_saveable")
+    with pytest.raises(ValueError, match="unknown remat_policy"):
+        LMConfig(remat=True, remat_policy="not_a_policy")
+    LMConfig(remat=True, remat_policy="dots_with_no_batch_dims_saveable")
