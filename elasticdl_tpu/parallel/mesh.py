@@ -67,8 +67,14 @@ def make_mesh(axis_sizes=None, devices=None) -> Mesh:
                 tuple(sizes), devices=list(chosen)
             )
             return Mesh(arr, axis_names=names)
-        except Exception:
-            pass
+        except (ImportError, ValueError, NotImplementedError) as e:
+            from elasticdl_tpu.common.log_utils import get_logger
+
+            get_logger("parallel.mesh").warning(
+                "Physical-topology mesh layout unavailable (%s); using "
+                "flat device-id reshape — multi-chip collectives may "
+                "cross non-neighbor ICI links", e,
+            )
     return Mesh(chosen.reshape(sizes), axis_names=names)
 
 

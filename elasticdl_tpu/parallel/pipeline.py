@@ -105,7 +105,7 @@ def pipeline_apply(stage_fn, stage_params, x_micro, axis_name="stage",
 
 
 def make_pipeline(stage_fn, mesh, axis_name="stage", batch_axis=None,
-                  remat=False):
+                  remat=False, remat_policy=None):
     """shard_map-wrapped pipeline: takes GLOBAL (stage_params, x_micro)
     with params stacked [n_stages, ...] (sharded over `axis_name`) and
     x_micro [M, mb, ...] (optionally sharded over `batch_axis` on mb for
@@ -114,7 +114,12 @@ def make_pipeline(stage_fn, mesh, axis_name="stage", batch_axis=None,
     from jax import shard_map
 
     if remat:
-        stage_fn = jax.checkpoint(stage_fn)
+        kwargs = {}
+        if remat_policy:
+            kwargs["policy"] = getattr(
+                jax.checkpoint_policies, remat_policy
+            )
+        stage_fn = jax.checkpoint(stage_fn, **kwargs)
     x_spec = P(None, batch_axis)
 
     def _validate(stage_params, x_micro):
@@ -280,7 +285,7 @@ def make_lm_pipeline(cfg, mesh, n_stages, num_microbatches,
 
         pipe = make_pipeline(
             stage_fn, mesh, axis_name=axis_name, batch_axis=batch_axis,
-            remat=cfg.remat,
+            remat=cfg.remat, remat_policy=cfg.remat_policy,
         )
         y = unmicrobatch(
             pipe(params["stages"], x_micro, dropout_rng)

@@ -48,6 +48,26 @@ def _merge(m1, l1, acc1, m2, l2, acc2):
     )
 
 
+def _rotate_next(blocks, t, axis_name, axis_size):
+    """Ring-shift K/V blocks to the next device for step t+1. Issued
+    BEFORE the step's attention math (no data dependence on it), so XLA's
+    async collectives stream the transfer over ICI while the MXU chews on
+    the current block. Skipped after the last fold — the rotated blocks
+    would be discarded, saving one full K/V hop per attention call. All
+    devices see the same t, so the cond branches uniformly and the
+    collective stays legal."""
+
+    def rotate(bs):
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        return tuple(
+            jax.lax.ppermute(b, axis_name, perm) for b in bs
+        )
+
+    return jax.lax.cond(
+        t + 1 < axis_size, rotate, lambda bs: bs, tuple(blocks)
+    )
+
+
 def ring_attention(q, k, v, axis_name, causal=False):
     """Exact attention with Q/K/V sharded [B, H, S_local, D] along
     `axis_name`. Call INSIDE shard_map; returns the local output block.
@@ -66,6 +86,9 @@ def ring_attention(q, k, v, axis_name, causal=False):
     def step(t, carry):
         m, l, acc, k_blk, v_blk = carry
         owner = (my_idx - t) % axis_size
+        k_next, v_next = _rotate_next(
+            (k_blk, v_blk), t, axis_name, axis_size
+        )
         if causal:
             # Full block mask decisions by global block order.
             def masked_block():
@@ -92,23 +115,6 @@ def ring_attention(q, k, v, axis_name, causal=False):
         else:
             mb, lb, accb = _block_attend(q, k_blk, v_blk, scale)
         m, l, acc = _merge(m, l, acc, mb, lb, accb)
-
-        # Rotate K/V to the next device — except after the last fold,
-        # where the rotated blocks would be discarded (saves one full
-        # K/V ICI hop per attention call). All devices see the same t, so
-        # the cond branches uniformly and the collective stays legal.
-        def rotate(blocks):
-            perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
-            return tuple(
-                jax.lax.ppermute(b, axis_name, perm) for b in blocks
-            )
-
-        k_next, v_next = jax.lax.cond(
-            t + 1 < axis_size,
-            rotate,
-            lambda blocks: blocks,
-            (k_blk, v_blk),
-        )
         return m, l, acc, k_next, v_next
 
     m, l, acc, _, _ = jax.lax.fori_loop(
@@ -118,14 +124,16 @@ def ring_attention(q, k, v, axis_name, causal=False):
 
 
 def make_ring_attention(mesh, axis_name="seq", causal=False,
-                        batch_axis=None):
+                        batch_axis=None, head_axis=None):
     """shard_map-wrapped ring attention: takes GLOBAL [B, H, S, D] arrays
-    sharded on S (and optionally on B along `batch_axis` for DP+SP meshes)
-    and returns the global output with the same sharding."""
+    sharded on S (and optionally on B along `batch_axis` for DP+SP meshes,
+    and on H along `head_axis` for TP composition — heads are embarrassingly
+    parallel in attention, so a head shard just runs its own ring) and
+    returns the global output with the same sharding."""
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
-    spec = P(batch_axis, None, axis_name, None)
+    spec = P(batch_axis, head_axis, axis_name, None)
     return shard_map(
         functools.partial(
             ring_attention, axis_name=axis_name, causal=causal
@@ -222,6 +230,9 @@ def zigzag_ring_attention(q, k, v, axis_name, causal=True):
     def step(t, carry):
         me, le, ae, ml, ll, al, ke, kl, ve, vl = carry
         owner = (my - t) % axis_size
+        ke_n, kl_n, ve_n, vl_n = _rotate_next(
+            (ke, kl, ve, vl), t, axis_name, axis_size
+        )
 
         # q early (chunk my) vs k early (chunk owner): full if owner < my,
         # diagonal if owner == my, skip if owner > my.
@@ -255,20 +266,7 @@ def zigzag_ring_attention(q, k, v, axis_name, causal=True):
         c3 = jax.lax.cond(owner >= my, ql_kl, empty)
         ml, ll, al = _merge(ml, ll, al, *c3)
         # (q early vs k late is always in the future: never computed.)
-
-        def rotate(blocks):
-            perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
-            return tuple(
-                jax.lax.ppermute(b, axis_name, perm) for b in blocks
-            )
-
-        ke, kl, ve, vl = jax.lax.cond(
-            t + 1 < axis_size,
-            rotate,
-            lambda blocks: blocks,
-            (ke, kl, ve, vl),
-        )
-        return me, le, ae, ml, ll, al, ke, kl, ve, vl
+        return me, le, ae, ml, ll, al, ke_n, kl_n, ve_n, vl_n
 
     m0e, l0e, a0e = empty()
     m0l, l0l, a0l = empty()
@@ -282,13 +280,13 @@ def zigzag_ring_attention(q, k, v, axis_name, causal=True):
 
 
 def make_zigzag_ring_attention(mesh, axis_name="seq", causal=True,
-                               batch_axis=None):
+                               batch_axis=None, head_axis=None):
     """shard_map-wrapped zigzag ring attention (balanced causal SP). Same
     contract as make_ring_attention; requires an even per-device sequence."""
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
-    spec = P(batch_axis, None, axis_name, None)
+    spec = P(batch_axis, head_axis, axis_name, None)
     return shard_map(
         functools.partial(
             zigzag_ring_attention, axis_name=axis_name, causal=causal
