@@ -84,6 +84,23 @@ def add_train_arguments(parser):
         help="publish eval/throughput scalars here as metrics.jsonl + "
         "TensorBoard event files (point tensorboard --logdir at it)",
     )
+    parser.add_argument(
+        "--profile_dir",
+        default="",
+        help="capture one XLA device trace of steady-state training steps "
+        "per worker under <profile_dir>/worker<id>/ (TensorBoard "
+        "trace-viewer format)",
+    )
+    parser.add_argument(
+        "--profile_start_step",
+        type=int,
+        default=10,
+        help="first profiled step (skip compile + warmup)",
+    )
+    parser.add_argument(
+        "--profile_steps", type=int, default=5,
+        help="number of steps in the trace window",
+    )
 
 
 def add_cluster_arguments(parser):

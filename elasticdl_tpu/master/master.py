@@ -46,6 +46,9 @@ _WORKER_RELAY_ARGS = [
     "prediction_data",
     "records_per_task",
     "num_epochs",
+    "profile_dir",
+    "profile_start_step",
+    "profile_steps",
 ]
 _PS_RELAY_ARGS = [
     "job_name",

@@ -67,7 +67,16 @@ def make_mesh(axis_sizes=None, devices=None) -> Mesh:
                 tuple(sizes), devices=list(chosen)
             )
             return Mesh(arr, axis_names=names)
-        except (ImportError, ValueError, NotImplementedError) as e:
+        except (
+            ImportError,
+            ValueError,
+            NotImplementedError,
+            # mesh_utils' TPU topology code bounds-checks with bare
+            # asserts and raises RuntimeError on exotic slice shapes;
+            # the flat reshape below is always a working layout.
+            AssertionError,
+            RuntimeError,
+        ) as e:
             from elasticdl_tpu.common.log_utils import get_logger
 
             get_logger("parallel.mesh").warning(

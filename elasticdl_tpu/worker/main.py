@@ -98,6 +98,15 @@ def main(argv=None):
         # server-side instead (ps/checkpoint.py). Applied right after the
         # trainer's lazy init on the first batch.
         trainer.restore_on_init = args.checkpoint_dir_for_init
+    profile_dir = ""
+    if args.profile_dir:
+        # Per-worker subdir: concurrent workers on one host must not
+        # interleave trace events in a single profile directory.
+        import os
+
+        profile_dir = os.path.join(
+            args.profile_dir, f"worker{args.worker_id}"
+        )
     worker = Worker(
         args.worker_id,
         mc,
@@ -108,6 +117,9 @@ def main(argv=None):
         job_type=job_type,
         log_loss_steps=args.log_loss_steps,
         extra_callbacks=extra_callbacks,
+        profile_dir=profile_dir,
+        profile_start_step=args.profile_start_step,
+        profile_steps=args.profile_steps,
     )
     worker.run()
     logger.info("Worker %d exiting", args.worker_id)

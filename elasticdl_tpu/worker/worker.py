@@ -34,6 +34,9 @@ class Worker:
         log_loss_steps=100,
         max_minibatch_retries=DEFAULT_MAX_MINIBATCH_RETRY_NUM,
         extra_callbacks=(),
+        profile_dir="",
+        profile_start_step=10,
+        profile_steps=5,
     ):
         self._worker_id = worker_id
         self._mc = master_client
@@ -47,6 +50,15 @@ class Worker:
         self._metadata = data_reader.metadata
         self._steps = 0
         self._timing = Timing()
+        # One-shot device trace of steady-state steps (past the compile):
+        # [profile_start_step, profile_start_step + profile_steps), written
+        # as a TensorBoard trace-viewer profile. The reference's deepest
+        # tracing is wall-clock Timing (timing_utils.py:17-48); on TPU the
+        # XLA-level trace is the tool that actually explains a step.
+        self._profile_dir = profile_dir
+        self._profile_start_step = profile_start_step
+        self._profile_steps = profile_steps
+        self._profiling = False
         self._callbacks = (
             model_spec.callbacks() if model_spec.callbacks else []
         ) + list(extra_callbacks)
@@ -54,17 +66,22 @@ class Worker:
     # ---------- public ----------
 
     def run(self):
-        if self._job_type in (
-            JobType.TRAINING_ONLY,
-            JobType.TRAINING_WITH_EVALUATION,
-        ):
-            self._train_and_evaluate()
-        elif self._job_type == JobType.EVALUATION_ONLY:
-            self._evaluate_only()
-        elif self._job_type == JobType.PREDICTION_ONLY:
-            self._predict_only()
-        else:
-            raise ValueError(f"unknown job type {self._job_type}")
+        try:
+            if self._job_type in (
+                JobType.TRAINING_ONLY,
+                JobType.TRAINING_WITH_EVALUATION,
+            ):
+                self._train_and_evaluate()
+            elif self._job_type == JobType.EVALUATION_ONLY:
+                self._evaluate_only()
+            elif self._job_type == JobType.PREDICTION_ONLY:
+                self._predict_only()
+            else:
+                raise ValueError(f"unknown job type {self._job_type}")
+        finally:
+            # A short job can end inside the profiled window; an unclosed
+            # trace would be empty on disk.
+            self._stop_profile_if_running()
 
     # ---------- job loops ----------
 
@@ -169,6 +186,10 @@ class Worker:
         features, labels = self._spec.feed(
             records, Modes.TRAINING, self._metadata
         )
+        if self._profile_dir:
+            # Before the dispatch, so the trace window covers exactly the
+            # steps the log names.
+            self._maybe_profile(self._steps + 1)
         accepted, version, loss = self._trainer.train_minibatch(
             features, labels
         )
@@ -183,6 +204,45 @@ class Worker:
                     version,
                     float(loss),
                 )
+
+    def _maybe_profile(self, next_step):
+        """Open/close the trace window around `next_step` (the step about
+        to be dispatched). Window = [start, start + steps); >= comparisons
+        so a start below the current counter (e.g. --profile_start_step 0)
+        still captures a window instead of silently never matching."""
+        end = self._profile_start_step + self._profile_steps
+        if (
+            not self._profiling
+            and self._profile_start_step <= next_step < end
+        ):
+            import jax
+
+            self._profiling = True
+            jax.profiler.start_trace(self._profile_dir)
+            logger.info(
+                "Profiling steps %d-%d to %s",
+                next_step,
+                end - 1,
+                self._profile_dir,
+            )
+        elif self._profiling and next_step >= end:
+            self._stop_profile_if_running()
+
+    def _stop_profile_if_running(self):
+        if not self._profiling:
+            return
+        import jax
+
+        self._profiling = False
+        try:
+            jax.profiler.stop_trace()
+            logger.info(
+                "Profile written to %s (view: tensorboard --logdir %s)",
+                self._profile_dir,
+                self._profile_dir,
+            )
+        except Exception:
+            logger.warning("Failed to finalize profile", exc_info=True)
 
     def _process_eval_batch(self, records):
         features, labels = self._spec.feed(

@@ -151,3 +151,76 @@ def test_minibatch_retry_then_task_failure_requeue():
         worker.run()
         assert m["task_d"].finished() and not m["task_d"].job_failed
         assert calls["n"] == 4  # 2 failures + 2 successful batches
+
+
+def test_profile_dir_captures_trace(tmp_path):
+    """--profile_dir: the worker writes one TensorBoard trace-viewer
+    profile of steady-state steps and closes it even when the job ends
+    inside the window."""
+    import os
+
+    records = test_module.make_linear_records(64)
+    reader = InMemoryReader(records)
+    profile_dir = str(tmp_path / "prof")
+    with start_master(
+        training_shards=reader.create_shards(),
+        records_per_task=32,
+        num_epochs=2,
+    ) as m:
+        spec = get_model_spec("test_module")
+        trainer = LocalTrainer(
+            spec.build_model(), spec.loss, spec.build_optimizer_spec()
+        )
+        worker = Worker(
+            0,
+            MasterClient(m["addr"], 0),
+            reader,
+            spec,
+            trainer,
+            minibatch_size=16,
+            job_type=JobType.TRAINING_ONLY,
+            profile_dir=profile_dir,
+            profile_start_step=2,
+            profile_steps=2,
+        )
+        worker.run()
+    found = []
+    for root, _, files in os.walk(profile_dir):
+        found += [f for f in files if f.endswith((".xplane.pb", ".json.gz",
+                                                  ".trace.json.gz"))]
+    assert found, f"no trace artifacts under {profile_dir}"
+
+
+def test_profile_start_step_zero_still_captures(tmp_path):
+    """--profile_start_step 0 (capture from the very first step) must not
+    silently skip the window."""
+    import os
+
+    records = test_module.make_linear_records(48)
+    reader = InMemoryReader(records)
+    profile_dir = str(tmp_path / "prof0")
+    with start_master(
+        training_shards=reader.create_shards(),
+        records_per_task=48,
+        num_epochs=1,
+    ) as m:
+        spec = get_model_spec("test_module")
+        trainer = LocalTrainer(
+            spec.build_model(), spec.loss, spec.build_optimizer_spec()
+        )
+        Worker(
+            0,
+            MasterClient(m["addr"], 0),
+            reader,
+            spec,
+            trainer,
+            minibatch_size=16,
+            job_type=JobType.TRAINING_ONLY,
+            profile_dir=profile_dir,
+            profile_start_step=0,
+            profile_steps=2,
+        ).run()
+    found = []
+    for root, _, files in os.walk(profile_dir):
+        found += [f for f in files if f.endswith(".xplane.pb")]
+    assert found, f"no trace artifacts under {profile_dir}"
