@@ -59,6 +59,13 @@ def add_data_arguments(parser):
     parser.add_argument(
         "--no_shuffle_shards", dest="shuffle_shards", action="store_false"
     )
+    parser.add_argument(
+        "--prefetch_records",
+        type=int,
+        default=1024,
+        help="read records on a background thread, this many ahead of the "
+        "training loop (0 disables prefetching)",
+    )
 
 
 def add_train_arguments(parser):

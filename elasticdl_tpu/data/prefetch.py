@@ -1,0 +1,92 @@
+"""Background-thread record prefetch: overlap reader IO with training.
+
+Reference counterpart: ParallelODPSDataReader's thread-pooled download
+(/root/reference/elasticdl/python/data/reader/odps_reader.py:26-251,
+odps_io.py:71-407 — sharded download queue feeding the training loop).
+Generalized here to ANY reader: `read_records(task)` runs the wrapped
+reader's generator on a producer thread that fills a bounded queue, so
+disk reads + CRC checks + proto decode overlap the accelerator's work on
+the previous minibatches instead of serializing with it. Record order is
+preserved (single producer per task); producer exceptions re-raise in the
+consumer at the position they occurred; closing/abandoning the consumer
+generator stops the producer instead of leaking a thread blocked on a
+full queue.
+"""
+
+import queue
+import threading
+
+_END = object()
+
+
+class PrefetchReader:
+    """Wrap a data reader so its per-task record stream is produced ahead
+    of consumption on a background thread (bounded by `buffer_records`)."""
+
+    def __init__(self, reader, buffer_records=1024):
+        if buffer_records < 1:
+            raise ValueError("buffer_records must be >= 1")
+        self._reader = reader
+        self._buffer_records = buffer_records
+
+    def read_records(self, task):
+        q = queue.Queue(maxsize=self._buffer_records)
+        stop = threading.Event()
+
+        def _put(item):
+            """put() that gives up when the consumer is gone."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for record in self._reader.read_records(task):
+                    if not _put(record):
+                        return
+            except BaseException as e:  # re-raised on the consumer side
+                _put((_END, e))
+                return
+            _put((_END, None))
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                # Records pass through untouched; only the producer's own
+                # (_END, err) pair terminates (readers can yield tuples —
+                # _END is module-private, so no user tuple can match).
+                if (
+                    isinstance(item, tuple)
+                    and len(item) == 2
+                    and item[0] is _END
+                ):
+                    err = item[1]
+                    if err is not None:
+                        raise err
+                    return
+                yield item
+        finally:
+            # Runs on exhaustion AND on generator close/GC (task failure
+            # mid-batch): release the producer and wait for it, so no
+            # stale thread is still reading the (possibly shared) file
+            # handle when the next task's producer starts.
+            stop.set()
+            t.join(timeout=5.0)
+            if t.is_alive():  # pragma: no cover - stuck in a blocked read
+                import logging
+
+                logging.getLogger("data.prefetch").warning(
+                    "prefetch producer for task %s did not exit within 5s",
+                    getattr(task, "task_id", "?"),
+                )
+
+    def __getattr__(self, name):
+        # Everything else (create_shards, metadata, ...) delegates to the
+        # wrapped reader.
+        return getattr(self._reader, name)

@@ -93,9 +93,10 @@ class RecordFile:
         self.num_records = num
         self._index_offset = index_offset
 
-    def _record_offset(self, i):
-        self._f.seek(self._index_offset + i * _OFF.size)
-        (off,) = _OFF.unpack(self._f.read(_OFF.size))
+    def _record_offset(self, i, f=None):
+        f = f or self._f
+        f.seek(self._index_offset + i * _OFF.size)
+        (off,) = _OFF.unpack(f.read(_OFF.size))
         return off
 
     def read(self, start: int, count: int):
@@ -118,31 +119,37 @@ class RecordFile:
         if native is not None:
             yield from self._read_native(native, start, count)
             return
-        self._f.seek(self._record_offset(start))
-        for i in range(count):
-            if self._version >= 2:
-                length, want = _LEN_CRC.unpack(self._f.read(_LEN_CRC.size))
-                payload = self._f.read(length)
-                if zlib.crc32(payload) != want:
-                    raise ValueError(
-                        f"{self.path}: CRC mismatch in record "
-                        f"{start + i} (corrupt file)"
-                    )
-            else:
-                (length,) = _LEN.unpack(self._f.read(_LEN.size))
-                payload = self._f.read(length)
-            yield payload
+        # Per-call handle: readers cache RecordFile objects, and with the
+        # prefetch reader a range scan runs on a producer thread — a
+        # shared seek/read cursor would interleave across threads.
+        with open(self.path, "rb") as f:
+            f.seek(self._record_offset(start, f))
+            for i in range(count):
+                if self._version >= 2:
+                    length, want = _LEN_CRC.unpack(f.read(_LEN_CRC.size))
+                    payload = f.read(length)
+                    if zlib.crc32(payload) != want:
+                        raise ValueError(
+                            f"{self.path}: CRC mismatch in record "
+                            f"{start + i} (corrupt file)"
+                        )
+                else:
+                    (length,) = _LEN.unpack(f.read(_LEN.size))
+                    payload = f.read(length)
+                yield payload
 
     def _read_native(self, native, start, count):
         # Payload span upper bound: distance between the first record's
         # offset and the end of the range (headers included — slack, not
-        # waste: the buffer is transient).
-        first = self._record_offset(start)
-        end = (
-            self._index_offset
-            if start + count == self.num_records
-            else self._record_offset(start + count)
-        )
+        # waste: the buffer is transient). Own handle for the index reads
+        # (thread-safety, same reason as the scan path).
+        with open(self.path, "rb") as f:
+            first = self._record_offset(start, f)
+            end = (
+                self._index_offset
+                if start + count == self.num_records
+                else self._record_offset(start + count, f)
+            )
         buf = np.empty(end - first, dtype=np.uint8)
         lens = np.empty(count, dtype=np.int64)
         import ctypes

@@ -46,6 +46,7 @@ _WORKER_RELAY_ARGS = [
     "prediction_data",
     "records_per_task",
     "num_epochs",
+    "prefetch_records",
     "profile_dir",
     "profile_start_step",
     "profile_steps",

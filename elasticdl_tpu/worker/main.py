@@ -82,6 +82,10 @@ def main(argv=None):
         from elasticdl_tpu.data.reader import CompositeReader
 
         reader = CompositeReader([reader_factory(o) for o in origins])
+    if args.prefetch_records > 0:
+        from elasticdl_tpu.data.prefetch import PrefetchReader
+
+        reader = PrefetchReader(reader, args.prefetch_records)
     mc = MasterClient(
         args.master_addr, args.worker_id, worker_host=args.worker_host
     )

@@ -209,3 +209,97 @@ def test_recordfile_corrupt_index_is_error_not_crash(tmp_path):
     with RecordFile(path) as rf:
         with pytest.raises(ValueError):
             list(rf.read(1, 1))
+
+
+# ---------- prefetch reader ----------
+
+
+def test_prefetch_preserves_order_and_metadata():
+    from elasticdl_tpu.data.prefetch import PrefetchReader
+
+    records = [f"r{i}".encode() for i in range(500)]
+    base = InMemoryReader(records)
+    pf = PrefetchReader(base, buffer_records=16)
+    task = FakeTask("all", 0, 500)
+    assert list(pf.read_records(task)) == records
+    # Delegation of non-stream attributes.
+    assert pf.create_shards() == base.create_shards()
+    # metadata is a fresh-object property on InMemoryReader; delegation is
+    # what's under test, not identity.
+    assert type(pf.metadata) is type(base.metadata)
+
+
+def test_prefetch_propagates_reader_errors():
+    from elasticdl_tpu.data.prefetch import PrefetchReader
+
+    class ExplodingReader:
+        def read_records(self, task):
+            yield b"ok-0"
+            yield b"ok-1"
+            raise RuntimeError("disk on fire")
+
+    pf = PrefetchReader(ExplodingReader(), buffer_records=4)
+    got = []
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        for r in pf.read_records(FakeTask("s", 0, 3)):
+            got.append(r)
+    assert got == [b"ok-0", b"ok-1"]
+
+
+def test_prefetch_abandoned_consumer_releases_producer():
+    """Closing the consumer generator mid-stream must let the producer
+    thread exit instead of blocking forever on the full queue."""
+    import threading
+    import time
+
+    from elasticdl_tpu.data.prefetch import PrefetchReader
+
+    records = [b"x"] * 10000
+    pf = PrefetchReader(InMemoryReader(records), buffer_records=2)
+    before = threading.active_count()
+    gen = pf.read_records(FakeTask("all", 0, 10000))
+    assert next(gen) == b"x"
+    gen.close()  # abandon mid-stream
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before
+
+
+def test_prefetch_rejects_bad_buffer():
+    from elasticdl_tpu.data.prefetch import PrefetchReader
+
+    with pytest.raises(ValueError):
+        PrefetchReader(InMemoryReader([b"a"]), buffer_records=0)
+
+
+def test_recordfile_concurrent_range_reads(tmp_path, monkeypatch):
+    """Range scans must be safe from multiple threads on ONE RecordFile
+    (readers cache the object; prefetch producers run on threads)."""
+    import threading
+
+    monkeypatch.setenv("EDL_NO_NATIVE", "1")  # exercise the python scanner
+    path = str(tmp_path / "a.edlr")
+    records = [f"rec-{i:05d}".encode() for i in range(2000)]
+    write_records(path, records)
+    rf = RecordFile(path)
+    results = {}
+
+    def scan(name, start, count):
+        results[name] = list(rf.read(start, count))
+
+    threads = [
+        threading.Thread(target=scan, args=(i, s, c))
+        for i, (s, c) in enumerate(
+            [(0, 2000), (500, 1000), (1500, 500), (0, 100)]
+        )
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results[0] == records
+    assert results[1] == records[500:1500]
+    assert results[2] == records[1500:]
+    assert results[3] == records[:100]
+    rf.close()
