@@ -19,22 +19,54 @@ import threading
 _END = object()
 
 
+DEFAULT_BUFFER_BYTES = 64 << 20
+
+
 class PrefetchReader:
     """Wrap a data reader so its per-task record stream is produced ahead
-    of consumption on a background thread (bounded by `buffer_records`)."""
+    of consumption on a background thread. The buffer is bounded BOTH by
+    record count (`buffer_records`) and by total buffered payload bytes
+    (`buffer_bytes`) — the byte bound is what keeps host RAM flat when
+    records are large (a 1024-record bound alone would hold ~150 MB of
+    224x224 image Examples)."""
 
-    def __init__(self, reader, buffer_records=1024):
+    def __init__(self, reader, buffer_records=1024,
+                 buffer_bytes=DEFAULT_BUFFER_BYTES):
         if buffer_records < 1:
             raise ValueError("buffer_records must be >= 1")
+        if buffer_bytes < 1:
+            raise ValueError("buffer_bytes must be >= 1")
         self._reader = reader
         self._buffer_records = buffer_records
+        self._buffer_bytes = buffer_bytes
 
     def read_records(self, task):
         q = queue.Queue(maxsize=self._buffer_records)
         stop = threading.Event()
+        # Outstanding payload bytes, guarded by its own lock; the producer
+        # parks while over budget (at least one record is always allowed
+        # through so a single huge record can't deadlock).
+        state = {"bytes": 0}
+        cond = threading.Condition()
 
-        def _put(item):
+        def _sizeof(item):
+            try:
+                return len(item)
+            except TypeError:
+                return 0
+
+        def _put(item, nbytes=0):
             """put() that gives up when the consumer is gone."""
+            with cond:
+                while (
+                    not stop.is_set()
+                    and state["bytes"] > 0
+                    and state["bytes"] + nbytes > self._buffer_bytes
+                ):
+                    cond.wait(timeout=0.1)
+                if stop.is_set():
+                    return False
+                state["bytes"] += nbytes
             while not stop.is_set():
                 try:
                     q.put(item, timeout=0.1)
@@ -46,7 +78,7 @@ class PrefetchReader:
         def produce():
             try:
                 for record in self._reader.read_records(task):
-                    if not _put(record):
+                    if not _put(record, _sizeof(record)):
                         return
             except BaseException as e:  # re-raised on the consumer side
                 _put((_END, e))
@@ -70,6 +102,9 @@ class PrefetchReader:
                     if err is not None:
                         raise err
                     return
+                with cond:
+                    state["bytes"] -= _sizeof(item)
+                    cond.notify()
                 yield item
         finally:
             # Runs on exhaustion AND on generator close/GC (task failure

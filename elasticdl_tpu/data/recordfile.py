@@ -93,8 +93,9 @@ class RecordFile:
         self.num_records = num
         self._index_offset = index_offset
 
-    def _record_offset(self, i, f=None):
-        f = f or self._f
+    def _record_offset(self, i, f):
+        """Index lookup on an explicit handle — callers each open their
+        own so concurrent range scans never share a seek cursor."""
         f.seek(self._index_offset + i * _OFF.size)
         (off,) = _OFF.unpack(f.read(_OFF.size))
         return off

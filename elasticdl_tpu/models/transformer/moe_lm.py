@@ -1,0 +1,169 @@
+"""Switch-MoE decoder LM: the flagship family's expert-parallel variant.
+
+No reference counterpart (the reference zoo is CNNs/recsys; SURVEY.md §2.10
+records no EP upstream). Every `moe_every`-th Block swaps its dense FFN for
+a SwitchMoE layer (layers/moe.py: top-1 routing, fixed capacity, one-hot
+einsum dispatch so shapes stay static under jit).
+
+Output contract: training=True returns {"logits", "aux_loss"} — aux_loss is
+the Switch load-balancing term ALREADY scaled by the config's
+aux_loss_weight, so the spec `loss` just adds it; training=False returns
+plain logits, keeping the evaluation/prediction wire paths (chunked metric
+folds, output processors) identical to the dense LM's. `param_specs` shards
+expert weights over the "model" mesh axis (the worker's
+--model_parallel_size axis doubles as the expert axis), composing EP with
+DP in the elastic AllReduce trainer.
+
+Padding caveat: on a padded final partial minibatch the trainer slices
+batch-dim outputs back to real rows before the CE, but the (scalar)
+aux_loss was computed over the padded batch — padding rows are cyclic
+repeats, so the regularizer is marginally reweighted there, matching the
+multi-host ragged-batch semantics the AllReduce trainer documents.
+"""
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from elasticdl_tpu.layers.moe import SwitchMoE, moe_param_specs
+from elasticdl_tpu.models.transformer import transformer_lm as tlm
+from elasticdl_tpu.models.transformer.transformer_lm import (
+    MultiHeadAttention,
+    embed_input,
+    head_output,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoELMConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    max_len: int = 256
+    num_experts: int = 4
+    moe_every: int = 2  # every k-th block is an expert block
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 1e-2
+    dropout: float = 0.0
+    attention: Optional[object] = None
+    activation_dtype: str = "bfloat16"
+    remat: bool = False
+    remat_policy: Optional[str] = None
+
+    def __post_init__(self):
+        tlm.validate_remat_policy(self.remat, self.remat_policy)
+        if self.moe_every < 1:
+            raise ValueError(
+                f"moe_every must be >= 1, got {self.moe_every} (use the "
+                f"dense transformer_lm for a model with no expert blocks)"
+            )
+        if self.num_experts < 2:
+            raise ValueError(
+                f"num_experts must be >= 2, got {self.num_experts}"
+            )
+
+
+class MoEBlock(nn.Module):
+    """Transformer block whose FFN is a routed expert layer; returns
+    (x, aux_loss)."""
+
+    config: MoELMConfig
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.activation_dtype)
+        h = nn.LayerNorm(dtype=dtype)(x)
+        x = x + MultiHeadAttention(cfg)(h, training)
+        h = nn.LayerNorm(dtype=dtype)(x)
+        out, aux = SwitchMoE(
+            num_experts=cfg.num_experts,
+            d_hidden=4 * cfg.d_model,
+            capacity_factor=cfg.capacity_factor,
+            dtype=cfg.activation_dtype,
+        )(h)
+        if cfg.dropout:
+            # Same regularization as the dense Block's FFN output.
+            out = nn.Dropout(
+                cfg.dropout, deterministic=not training
+            )(out)
+        return x + out, aux
+
+
+class MoETransformerLM(nn.Module):
+    config: MoELMConfig = MoELMConfig()
+
+    @nn.compact
+    def __call__(self, tokens, training: bool = False):
+        cfg = self.config
+        x = embed_input(cfg, tokens)
+        block_cls, moe_cls = tlm.Block, MoEBlock
+        if cfg.remat:
+            kwargs = {"static_argnums": (2,)}
+            if cfg.remat_policy:
+                import jax
+
+                kwargs["policy"] = getattr(
+                    jax.checkpoint_policies, cfg.remat_policy
+                )
+            block_cls = nn.remat(tlm.Block, **kwargs)
+            moe_cls = nn.remat(MoEBlock, **kwargs)
+        aux_total = jnp.zeros((), jnp.float32)
+        for i in range(cfg.n_layers):
+            if (i + 1) % cfg.moe_every == 0:
+                x, aux = moe_cls(cfg)(x, training)
+                aux_total = aux_total + aux
+            else:
+                x = block_cls(cfg)(x, training)
+        logits = head_output(cfg, x)
+        if not training:
+            return logits
+        return {
+            # Pre-scaled by the INSTANCE config so sweeping
+            # aux_loss_weight actually takes effect in the spec loss.
+            "logits": logits,
+            "aux_loss": cfg.aux_loss_weight * aux_total,
+        }
+
+
+# ---------- model spec contract ----------
+
+
+def custom_model(config: MoELMConfig = None):
+    return MoETransformerLM(config or MoELMConfig())
+
+
+def loss(labels, outputs):
+    """Next-token CE + Switch load-balancing aux (the model pre-scales the
+    aux term by its instance config's aux_loss_weight)."""
+    return tlm.loss(labels, outputs["logits"]) + outputs["aux_loss"]
+
+
+def optimizer():
+    return tlm.optimizer()
+
+
+def feed(records, mode, metadata):
+    return tlm.feed(records, mode, metadata)
+
+
+def param_specs(variables):
+    """DP x EP layout for the elastic trainer: expert weight tensors shard
+    over the "model" mesh axis (one axis serves TP in the dense LM and EP
+    here), router + dense blocks + embeddings replicated."""
+    # moe_param_specs walks the whole tree: w_in/w_out tensors shard over
+    # the axis, every other leaf (router, dense blocks, embeddings, head)
+    # replicates — exactly the DP x EP layout.
+    return {
+        k: moe_param_specs(v, expert_axis="model")
+        for k, v in variables.items()
+    }
+
+
+def eval_metrics_fn():
+    # Evaluation sees plain logits (training=False output), so the dense
+    # LM's metrics apply unchanged.
+    return tlm.eval_metrics_fn()

@@ -58,18 +58,23 @@ class LMConfig:
     remat_policy: Optional[str] = None
 
     def __post_init__(self):
-        if self.remat_policy is not None:
-            if not self.remat:
-                raise ValueError(
-                    "remat_policy is set but remat=False — the policy "
-                    "would be silently ignored; enable remat or drop the "
-                    "policy"
-                )
-            if not hasattr(jax.checkpoint_policies, self.remat_policy):
-                raise ValueError(
-                    f"unknown remat_policy {self.remat_policy!r} (see "
-                    f"jax.checkpoint_policies)"
-                )
+        validate_remat_policy(self.remat, self.remat_policy)
+
+
+def validate_remat_policy(remat, remat_policy):
+    """Config-time validation shared by the dense and MoE LM configs."""
+    if remat_policy is None:
+        return
+    if not remat:
+        raise ValueError(
+            "remat_policy is set but remat=False — the policy would be "
+            "silently ignored; enable remat or drop the policy"
+        )
+    if not hasattr(jax.checkpoint_policies, remat_policy):
+        raise ValueError(
+            f"unknown remat_policy {remat_policy!r} (see "
+            f"jax.checkpoint_policies)"
+        )
 
 
 def flagship_config(max_len: int = 4096) -> "LMConfig":
@@ -235,12 +240,15 @@ def param_specs(variables):
     }
 
 
-def eval_metrics_fn():
-    def ce(outputs, labels):
-        logits = np.asarray(outputs, np.float32)
-        labels = np.asarray(labels).astype(np.int64)
-        logits = logits - logits.max(-1, keepdims=True)
-        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
-        return -np.take_along_axis(logp, labels[..., None], -1)
+def token_ce(outputs, labels):
+    """Per-token CE from logits (numpy; eval-metric building block, also
+    reused by the MoE variant on its logits field)."""
+    logits = np.asarray(outputs, np.float32)
+    labels = np.asarray(labels).astype(np.int64)
+    logits = logits - logits.max(-1, keepdims=True)
+    logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    return -np.take_along_axis(logp, labels[..., None], -1)
 
-    return {"token_ce": MeanMetric(ce)}
+
+def eval_metrics_fn():
+    return {"token_ce": MeanMetric(token_ce)}

@@ -85,7 +85,7 @@ def main(argv=None):
     if args.prefetch_records > 0:
         from elasticdl_tpu.data.prefetch import PrefetchReader
 
-        reader = PrefetchReader(reader, args.prefetch_records)
+        reader = PrefetchReader(reader, buffer_records=args.prefetch_records)
     mc = MasterClient(
         args.master_addr, args.worker_id, worker_host=args.worker_host
     )

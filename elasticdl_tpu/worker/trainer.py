@@ -133,12 +133,23 @@ class JaxTrainer(Trainer):
             outputs, new_state = out if mutable else (out, state)
             labels_real = labels
             if slice_to is not None:
-                outputs = jax.tree_util.tree_map(
-                    lambda o: o[:slice_to], outputs
-                )
-                labels_real = jax.tree_util.tree_map(
-                    lambda l: l[:slice_to], labels
-                )
+                # Only leaves carrying the batch dim get sliced back to
+                # the real rows (bit-identical CE vs single-device).
+                # Reduced scalars a model emits (e.g. a MoE aux loss) WERE
+                # computed over the padded batch; padding is cyclic
+                # repetition of real rows, so such regularizers are
+                # marginally reweighted on a task's final partial
+                # minibatch — same semantics as the multi-host ragged
+                # batch documented in the AllReduce trainer.
+                batch_n = jax.tree_util.tree_leaves(features)[0].shape[0]
+
+                def trim(o):
+                    if getattr(o, "ndim", 0) >= 1 and o.shape[0] == batch_n:
+                        return o[:slice_to]
+                    return o
+
+                outputs = jax.tree_util.tree_map(trim, outputs)
+                labels_real = jax.tree_util.tree_map(trim, labels)
             return self._loss_fn(labels_real, outputs), new_state
 
         (loss, new_state), grads = jax.value_and_grad(

@@ -303,3 +303,31 @@ def test_recordfile_concurrent_range_reads(tmp_path, monkeypatch):
     assert results[2] == records[1500:]
     assert results[3] == records[:100]
     rf.close()
+
+
+def test_prefetch_byte_budget_limits_buffering():
+    """Large records: the producer must park once the byte budget is hit
+    instead of buffering buffer_records x record_size of host RAM."""
+    import threading
+    import time
+
+    from elasticdl_tpu.data.prefetch import PrefetchReader
+
+    produced = []
+
+    class BigRecordReader:
+        def read_records(self, task):
+            for i in range(100):
+                produced.append(i)
+                yield b"x" * (1 << 20)  # 1 MiB each
+
+    pf = PrefetchReader(
+        BigRecordReader(), buffer_records=1024, buffer_bytes=4 << 20
+    )
+    gen = pf.read_records(FakeTask("s", 0, 100))
+    assert len(next(gen)) == 1 << 20
+    time.sleep(0.5)  # give the producer time to run ahead
+    # Byte budget (4 MiB) + queue slack, nowhere near 100 records.
+    assert len(produced) <= 12, len(produced)
+    rest = list(gen)
+    assert len(rest) == 99 and len(produced) == 100
