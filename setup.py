@@ -13,7 +13,7 @@ setup(
     ),
     packages=find_packages(include=["elasticdl_tpu", "elasticdl_tpu.*"]),
     package_data={"elasticdl_tpu.proto": ["*.proto"],
-                  "elasticdl_tpu.native": ["kernels.cc", "Makefile"]},
+                  "elasticdl_tpu.native": ["*.cc", "Makefile"]},
     python_requires=">=3.10",
     install_requires=[
         "jax",

@@ -27,20 +27,7 @@ def linear_data(tmp_path_factory):
     return path
 
 
-def run_edl(*argv, timeout=240):
-    env = dict(os.environ)
-    env["PYTHONPATH"] = f"{REPO}:{REPO}/tests"
-    # Subprocess workers must stay on the virtual CPU platform (the outer
-    # environment may point JAX at the real TPU).
-    env["JAX_PLATFORMS"] = "cpu"
-    return subprocess.run(
-        [sys.executable, "-m", "elasticdl_tpu.client.main", *argv],
-        capture_output=True,
-        text=True,
-        timeout=timeout,
-        env=env,
-        cwd=REPO,
-    )
+from test_utils import run_edl  # noqa: E402  (shared CLI-launch recipe)
 
 
 def test_train_then_evaluate_local_cluster(tmp_path, linear_data):

@@ -1,0 +1,75 @@
+"""`edl zoo init/list/build/push` unit coverage (reference
+elasticdl_client zoo commands, api.py:33-113) — scaffold generation, zoo
+listing, Dockerfile build staging, and the push dry-run path, all without
+docker or a cluster."""
+
+import shutil
+import sys
+
+from tests.test_utils import run_edl
+
+
+def test_zoo_init_scaffold_is_a_valid_model_spec(tmp_path):
+    res = run_edl("zoo", "init", "--path", str(tmp_path), "--name", "mymodel")
+    assert res.returncode == 0, res.stderr[-2000:]
+    target = tmp_path / "mymodel.py"
+    assert target.exists()
+    # The scaffold must satisfy the spec contract out of the box.
+    sys.path.insert(0, str(tmp_path))
+    try:
+        from elasticdl_tpu.common.model_utils import get_model_spec
+
+        spec = get_model_spec("mymodel")
+        assert spec.build_model() is not None
+        assert spec.build_optimizer_spec() is not None
+    finally:
+        sys.path.remove(str(tmp_path))
+        # Drop the cached module: it is bound to this test's tmp dir and
+        # would shadow any later import of the same name.
+        sys.modules.pop("mymodel", None)
+    # Refuses to clobber without --force.
+    res = run_edl("zoo", "init", "--path", str(tmp_path), "--name", "mymodel")
+    assert res.returncode == 1
+    res = run_edl(
+        "zoo", "init", "--path", str(tmp_path), "--name", "mymodel",
+        "--force",
+    )
+    assert res.returncode == 0
+
+
+def test_zoo_list_names_builtin_models():
+    res = run_edl("zoo", "list")
+    assert res.returncode == 0
+    names = res.stdout.split()
+    for expected in ("mnist", "resnet50", "transformer", "dac_ctr"):
+        assert expected in names, names
+
+
+def test_zoo_build_stages_dockerfile(tmp_path):
+    zoo_dir = tmp_path / "myzoo"
+    zoo_dir.mkdir()
+    (zoo_dir / "m.py").write_text("# model def\n")
+    build_dir = tmp_path / "build"
+    res = run_edl(
+        "zoo", "build", "--path", str(zoo_dir),
+        "--build_dir", str(build_dir), "--image", "reg.example/zoo:1",
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    dockerfile = (build_dir / "Dockerfile").read_text()
+    assert "COPY myzoo /model_zoo/myzoo" in dockerfile
+    assert "PYTHONPATH=/model_zoo" in dockerfile
+    assert (build_dir / "myzoo" / "m.py").exists()
+    assert "docker build -t reg.example/zoo:1" in res.stdout
+
+
+def test_zoo_push_dry_run_and_missing_docker(tmp_path):
+    res = run_edl("zoo", "push", "--image", "reg.example/zoo:1",
+                  "--dry_run")
+    assert res.returncode == 0
+    assert "docker push reg.example/zoo:1" in res.stdout
+    if shutil.which("docker") is None:
+        # No docker in this environment: a real push must fail loudly and
+        # still print the command to run elsewhere.
+        res = run_edl("zoo", "push", "--image", "reg.example/zoo:1")
+        assert res.returncode == 1
+        assert "docker push reg.example/zoo:1" in res.stdout

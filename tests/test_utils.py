@@ -49,3 +49,27 @@ def start_master(
         }
     finally:
         server.stop(0)
+
+
+def run_edl(*argv, timeout=240, include_tests_on_path=True):
+    """Run the `edl` CLI as a subprocess on the virtual CPU platform (the
+    outer environment may point JAX at the real TPU). One definition so
+    the CLI-launch recipe can't drift between test files."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        f"{repo}:{repo}/tests" if include_tests_on_path else repo
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "elasticdl_tpu.client.main", *argv],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+        cwd=repo,
+    )
