@@ -93,3 +93,29 @@ def test_string_and_bytes_tensor_roundtrip():
         tensor_utils.ndarray_to_tensor_pb(
             np.array([1.0, "x"], dtype=object), "bad"
         )
+
+
+def test_codec_fuzz_roundtrip():
+    """Randomized shapes/dtypes (incl. 0-d, empty dims, F-order, slices)
+    must roundtrip bit-exactly through the wire codec."""
+    rng = np.random.default_rng(42)
+    dtypes = [np.float32, np.float64, np.int32, np.int64, np.uint8,
+              np.bool_, np.float16, bfloat16]
+    for trial in range(200):
+        nd = int(rng.integers(0, 4))
+        shape = tuple(int(rng.integers(0, 5)) for _ in range(nd))
+        dtype = dtypes[trial % len(dtypes)]
+        arr = (rng.normal(size=shape) * 100).astype(dtype)
+        if trial % 3 == 0 and nd >= 2 and all(shape):
+            arr = np.asfortranarray(arr)  # non-C-contiguous
+        elif trial % 5 == 0 and nd >= 1 and shape[0] >= 2:
+            arr = arr[::2]  # strided view
+        t = tensor_utils.ndarray_to_tensor_pb(arr)
+        back = tensor_utils.tensor_pb_to_ndarray(
+            pb.Tensor.FromString(t.SerializeToString())
+        )
+        assert back.shape == arr.shape, (trial, arr.shape, back.shape)
+        assert back.dtype == arr.dtype, (trial, arr.dtype, back.dtype)
+        np.testing.assert_array_equal(
+            np.asarray(back), np.asarray(arr), err_msg=str(trial)
+        )
