@@ -63,10 +63,15 @@ def run_drill(
     num_epochs=8,
     minibatch_size=32,
     records_per_task=64,
+    strategy=None,
     extra_args=(),
     env_overrides=None,
     timeout=300,
 ):
+    """strategy: explicit --distribution_strategy name; default derives
+    from num_ps (ParameterServerStrategy when PS shards are requested,
+    Local otherwise). Pass "AllreduceStrategy" to drill the elastic
+    membership/broadcast path."""
     import grpc
 
     from elasticdl_tpu.common import rpc
@@ -90,7 +95,8 @@ def run_drill(
             "--num_workers", str(num_workers),
             "--num_ps", str(num_ps),
             "--distribution_strategy",
-            "ParameterServerStrategy" if num_ps else "Local",
+            strategy
+            or ("ParameterServerStrategy" if num_ps else "Local"),
             "--instance_backend", "local_process",
             "--master_port", str(port),
             *extra_args,
@@ -188,7 +194,20 @@ def main():
     p.add_argument("--num_workers", type=int, default=2)
     p.add_argument("--num_ps", type=int, default=1)
     p.add_argument("--num_epochs", type=int, default=8)
+    p.add_argument(
+        "--strategy",
+        default=None,
+        help="explicit distribution strategy (default from --num_ps)",
+    )
     args = p.parse_args()
+    if args.strategy and args.strategy != "ParameterServerStrategy":
+        if args.num_ps:
+            print(
+                f"note: --strategy {args.strategy} ignores parameter "
+                f"servers; overriding --num_ps {args.num_ps} -> 0",
+                file=sys.stderr,
+            )
+        args.num_ps = 0
     result = run_drill(
         args.training_data,
         args.model_zoo,
@@ -196,6 +215,7 @@ def main():
         num_workers=args.num_workers,
         num_ps=args.num_ps,
         num_epochs=args.num_epochs,
+        strategy=args.strategy,
     )
     result.pop("log_tail", None)
     print(json.dumps(result))
