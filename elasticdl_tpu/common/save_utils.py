@@ -2,7 +2,8 @@
 
 The PS strategy checkpoints server-side (ps/checkpoint.py, the reference's
 PS-side scheme); for strategies whose state lives in the worker this module
-saves the trainer's (variables, version) as an .npz of wire-named arrays —
+saves the trainer's (variables, opt_state, rng, version) as an .npz of
+wire-named arrays (train-end model exports carry weights only) —
 the analog of the reference's CheckpointSaver + SavedModel export hand-off
 (/root/reference/elasticdl/python/common/save_utils.py:151-282,
 master/callbacks.py:38-66).
@@ -10,6 +11,7 @@ master/callbacks.py:38-66).
 
 import os
 
+import jax
 import numpy as np
 
 from elasticdl_tpu.common.log_utils import get_logger
@@ -24,7 +26,12 @@ def _normalize(path):
     return path if path.endswith(".npz") else path + ".npz"
 
 
-def save_trainer_checkpoint(trainer, path):
+_OPT_PREFIX = "__opt__"
+_OPT_SPEC_KEY = "__opt_spec__"
+_RNG_KEY = "__rng__"
+
+
+def save_trainer_checkpoint(trainer, path, include_training_state=True):
     exported = trainer.export_variables()
     if exported is None or exported.get("variables") is None:
         # E.g. a relaunched worker that only picked up the train-end export
@@ -33,11 +40,27 @@ def save_trainer_checkpoint(trainer, path):
         raise ValueError("trainer has no exportable state")
     path = _normalize(path)
     named, _ = flatten_params(exported["variables"])
+    arrays = {name: np.asarray(leaf) for name, leaf in named.items()}
+    # Optimizer state is an optax pytree of NamedTuples — no stable dict
+    # paths, so leaves go in flatten order; the restoring trainer supplies
+    # the treedef (same optimizer spec) to rebuild it. Adding these keys is
+    # what makes a kill-and-resume Adam run bitwise-identical to an
+    # uninterrupted one instead of resetting the moments.
+    if include_training_state and exported.get("opt_state") is not None:
+        for i, leaf in enumerate(
+            jax.tree_util.tree_leaves(exported["opt_state"])
+        ):
+            arrays["%s%06d" % (_OPT_PREFIX, i)] = np.asarray(leaf)
+        spec = getattr(trainer, "_optimizer_spec", None)
+        if spec is not None:
+            arrays[_OPT_SPEC_KEY] = np.bytes_(spec.name.encode())
+    if include_training_state and exported.get("rng") is not None:
+        arrays[_RNG_KEY] = np.asarray(exported["rng"])
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     np.savez(
         path[: -len(".npz")],
         __version__=np.int64(exported["version"]),
-        **{name: np.asarray(leaf) for name, leaf in named.items()},
+        **arrays,
     )
     logger.info("Saved model checkpoint to %s", path)
 
@@ -46,11 +69,63 @@ def restore_trainer_checkpoint(trainer, path):
     """Restore into an ALREADY-INITIALIZED trainer (variables define the
     pytree to fill)."""
     with np.load(_normalize(path)) as data:
-        named = {k: data[k] for k in data.files if k != "__version__"}
+        meta_keys = {"__version__", _RNG_KEY, _OPT_SPEC_KEY}
+        named = {
+            k: data[k]
+            for k in data.files
+            if k not in meta_keys and not k.startswith(_OPT_PREFIX)
+        }
+        opt_leaves = [
+            data[k]
+            for k in sorted(data.files)
+            if k.startswith(_OPT_PREFIX) and k != _OPT_SPEC_KEY
+        ]
+        saved_spec = (
+            bytes(data[_OPT_SPEC_KEY]).decode()
+            if _OPT_SPEC_KEY in data.files
+            else None
+        )
+        rng = data[_RNG_KEY] if _RNG_KEY in data.files else None
         version = int(data["__version__"])
     exported = trainer.export_variables()
     exported["variables"] = unflatten_like(exported["variables"], named)
     exported["version"] = version
+    exported["rng"] = rng
+    cur_spec = getattr(trainer, "_optimizer_spec", None)
+    if opt_leaves and exported.get("opt_state") is not None:
+        cur_leaves, treedef = jax.tree_util.tree_flatten(
+            exported["opt_state"]
+        )
+        # Structural match alone can't tell adam moments from another
+        # optimizer's identically-shaped slots, so the spec name is
+        # compared too when both sides carry one.
+        spec_ok = (
+            saved_spec is None
+            or cur_spec is None
+            or saved_spec == cur_spec.name
+        )
+        compatible = spec_ok and len(cur_leaves) == len(opt_leaves) and all(
+            tuple(np.shape(cur)) == tuple(np.shape(saved))
+            # .dtype avoids np.asarray, which would pull device leaves to
+            # host just to read their dtype.
+            and np.dtype(getattr(cur, "dtype", type(cur))) == saved.dtype
+            for cur, saved in zip(cur_leaves, opt_leaves)
+        )
+        if compatible:
+            exported["opt_state"] = jax.tree_util.tree_unflatten(
+                treedef, opt_leaves
+            )
+        else:
+            logger.warning(
+                "Checkpoint optimizer state (%d leaves) does not match the "
+                "current optimizer's structure/shapes (%d leaves; optimizer "
+                "spec changed?); re-initializing optimizer state",
+                len(opt_leaves),
+                len(cur_leaves),
+            )
+            exported["opt_state"] = None
+    else:
+        exported["opt_state"] = None
     trainer.restore_variables(exported)
     logger.info("Restored model checkpoint from %s (version %d)", path, version)
 
@@ -63,4 +138,8 @@ class ExportModelCallback:
         self._path = output_path
 
     def on_train_end(self, trainer):
-        save_trainer_checkpoint(trainer, self._path)
+        # A model export, not a resume point: ship weights only (Adam
+        # moments would triple the artifact).
+        save_trainer_checkpoint(
+            trainer, self._path, include_training_state=False
+        )

@@ -139,6 +139,14 @@ class AllReduceTrainer(JaxTrainer):
     def world_size(self):
         return self._world_size
 
+    def restore_variables(self, exported):
+        # The broadcast server reads (variables, opt_state, version) from
+        # gRPC threads; a checkpoint restore swaps all three, so it must
+        # hold the same lock or a regrouping peer could pull checkpoint
+        # weights paired with init-time optimizer moments.
+        with self._state_lock:
+            super().restore_variables(exported)
+
     def _state_provider(self):
         with self._state_lock:
             if self._variables is None:

@@ -210,6 +210,12 @@ class JaxTrainer(Trainer):
     def export_variables(self):
         return {
             "variables": jax.device_get(self._variables),
+            # Left as device arrays: callers that persist it (the saver)
+            # materialize per leaf; callers that only need the structure
+            # or discard it (weights-only export, restore template) skip
+            # a 2x-model-size device-to-host copy.
+            "opt_state": self._opt_state,
+            "rng": np.asarray(self._rng),
             "version": self._version,
         }
 
@@ -217,7 +223,19 @@ class JaxTrainer(Trainer):
         self._variables = jax.tree_util.tree_map(
             jnp.asarray, exported["variables"]
         )
-        self._opt_state = self._optax.init(self._variables["params"])
+        if exported.get("opt_state") is not None:
+            self._opt_state = jax.tree_util.tree_map(
+                jnp.asarray, exported["opt_state"]
+            )
+        else:
+            # Pre-round-3 checkpoints carried weights only; resuming from
+            # one resets the optimizer moments (the old, lossy behavior).
+            logger.warning(
+                "Checkpoint has no optimizer state; re-initializing it"
+            )
+            self._opt_state = self._optax.init(self._variables["params"])
+        if exported.get("rng") is not None:
+            self._rng = jnp.asarray(exported["rng"])
         self._version = exported["version"]
         self._train_step = self._build_train_step()
         self._forward = self._build_forward()
