@@ -14,6 +14,7 @@ full queue.
 """
 
 import queue
+import sys
 import threading
 
 _END = object()
@@ -50,10 +51,14 @@ class PrefetchReader:
         cond = threading.Condition()
 
         def _sizeof(item):
-            try:
+            if isinstance(item, (bytes, bytearray, memoryview)):
                 return len(item)
-            except TypeError:
-                return 0
+            # Parsed rows/objects (e.g. CSV tuples) have no byte length
+            # (len() would count fields, not bytes); approximate with the
+            # interpreter's shallow size so the byte budget still bounds
+            # host RAM rather than silently degrading to the record-count
+            # bound alone.
+            return sys.getsizeof(item)
 
         def _put(item, nbytes=0):
             """put() that gives up when the consumer is gone."""

@@ -151,6 +151,15 @@ class RecordFile:
                 if start + count == self.num_records
                 else self._record_offset(start + count, f)
             )
+        # The two offsets come from untrusted on-disk index entries; clamp
+        # before allocating so a flipped bit raises the same corrupt-file
+        # error the scanner would, not a negative-size ValueError or a
+        # pathological multi-GB np.empty.
+        if not 0 <= first <= end <= self._index_offset:
+            raise ValueError(
+                f"{self.path}: index entries out of bounds for records "
+                f"[{start}, {start + count}) (corrupt file)"
+            )
         buf = np.empty(end - first, dtype=np.uint8)
         lens = np.empty(count, dtype=np.int64)
         import ctypes

@@ -65,16 +65,16 @@ class PserverServicer:
         # Quorum counts DISTINCT workers, not raw pushes: one fast worker
         # pushing twice in a window must not satisfy grads_to_wait alone
         # (its second push still contributes to the average). Anonymous
-        # pushes (worker_id_plus_one == 0) each count as a fresh worker,
-        # matching the reference's coarse push counter
-        # (python/ps/servicer.py:166-236). Liveness escape hatch: if the
-        # quorum hasn't filled within sync_window_timeout of the window's
-        # first push (survivors of an elastic shrink keep re-pushing), the
-        # next push applies whatever has accumulated rather than hanging
-        # the job forever.
+        # sync pushes are rejected outright — counting each as a fresh
+        # worker (the reference's coarse push counter,
+        # python/ps/servicer.py:166-236) would let an old client silently
+        # weaken the quorum back to raw push counting. Liveness escape
+        # hatch: if the quorum hasn't filled within sync_window_timeout of
+        # the window's first push (survivors of an elastic shrink keep
+        # re-pushing), the next push applies whatever has accumulated
+        # rather than hanging the job forever.
         self._sync_window_timeout = sync_window_timeout
         self._push_workers = set()
-        self._anon_pushes = 0
         self._window_start = None
 
     # ---------- rpc methods (names match rpc.PSERVER_SERVICE) ----------
@@ -169,6 +169,11 @@ class PserverServicer:
     # ---------- sync path ----------
 
     def _push_sync(self, request):
+        if request.worker_id_plus_one <= 0:
+            raise ValueError(
+                "sync-mode gradient pushes must carry a worker_id; the "
+                "distinct-worker quorum cannot count anonymous pushes"
+            )
         with self._version_lock:
             if (
                 request.gradients.version
@@ -194,11 +199,8 @@ class PserverServicer:
             self._params.total_records += request.batch_size
             if self._window_start is None:
                 self._window_start = time.monotonic()
-            if request.worker_id_plus_one > 0:
-                self._push_workers.add(request.worker_id_plus_one - 1)
-            else:
-                self._anon_pushes += 1
-            quorum = len(self._push_workers) + self._anon_pushes
+            self._push_workers.add(request.worker_id_plus_one - 1)
+            quorum = len(self._push_workers)
             window_expired = (
                 time.monotonic() - self._window_start
                 > self._sync_window_timeout
@@ -234,7 +236,6 @@ class PserverServicer:
             self._sparse_acc.clear()
             self._grad_n = 0
             self._push_workers.clear()
-            self._anon_pushes = 0
             self._window_start = None
             self._params.version += 1
             version = self._params.version

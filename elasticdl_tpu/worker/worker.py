@@ -67,6 +67,17 @@ class Worker:
 
     def run(self):
         try:
+            if self._profile_dir and self._job_type in (
+                JobType.EVALUATION_ONLY,
+                JobType.PREDICTION_ONLY,
+            ):
+                # The trace window opens on the training minibatch path
+                # only; say so instead of silently writing nothing.
+                logger.warning(
+                    "--profile_dir is only honored for training jobs; "
+                    "no trace will be captured for job type %s",
+                    self._job_type,
+                )
             if self._job_type in (
                 JobType.TRAINING_ONLY,
                 JobType.TRAINING_WITH_EVALUATION,

@@ -193,6 +193,45 @@ def test_dp_pp_train_step():
     after = params2["stages"]["Block_0"]["Dense_0"]["kernel"]
     assert not np.allclose(np.asarray(before), np.asarray(after))
 
+    # Numeric equivalence vs the pure-PP run on the same full batch: a
+    # DP-axis gradient-averaging bug would scale the grads, which Adam's
+    # normalized update would mostly hide — so compare loss AND raw grads,
+    # not post-optimizer params.
+    def loss_and_grads(apply, p, f, l):
+        def loss_of(p):
+            return tlm.loss(l, apply(p, f, training=True))
+
+        return jax.value_and_grad(loss_of)(p)
+
+    with mesh:
+        loss_dp, grads_dp = jax.jit(
+            lambda p, f, l: loss_and_grads(apply_fn, p, f, l),
+            in_shardings=(shardings, batch_sh, batch_sh),
+        )(
+            jax.device_put(params, shardings),
+            jax.device_put(features, batch_sh),
+            jax.device_put(labels, batch_sh),
+        )
+
+    mesh_pp = Mesh(np.array(jax.devices()[:pp]), ("stage",))
+    init_pp, apply_pp = make_lm_pipeline(cfg, mesh_pp, pp, m)
+    params_pp = init_pp(jax.random.PRNGKey(0), features)
+    with mesh_pp:
+        loss_pp, grads_pp = jax.jit(
+            lambda p, f, l: loss_and_grads(apply_pp, p, f, l)
+        )(params_pp, features, labels)
+
+    np.testing.assert_allclose(
+        float(loss_dp), float(loss_pp), rtol=2e-5, atol=2e-5
+    )
+    for got, want in zip(
+        jax.tree_util.tree_leaves(grads_dp),
+        jax.tree_util.tree_leaves(grads_pp),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5
+        )
+
 
 def test_microbatch_validation():
     with pytest.raises(ValueError):

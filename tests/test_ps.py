@@ -239,24 +239,33 @@ def test_pserver_sync_quorum_and_staleness():
         sync_version_tolerance=0,
     )
     try:
-        client = PSClient([server.addr])
-        client.push_model({"w": np.zeros(2, np.float32)}, [])
+        w1 = PSClient([server.addr], worker_id=1)
+        w2 = PSClient([server.addr], worker_id=2)
+        w1.push_model({"w": np.zeros(2, np.float32)}, [])
         g1 = {"w": np.array([1.0, 1.0], np.float32)}
         g2 = {"w": np.array([3.0, 3.0], np.float32)}
+        # An anonymous sync push is rejected outright: the distinct-worker
+        # quorum can't count it (old reference clients would silently
+        # degrade the quorum to raw push counting).
+        anon = PSClient([server.addr])
+        with pytest.raises(Exception, match="worker_id"):
+            anon.push_gradients(g1, {}, version=0)
+        anon.close()
         # First push buffers (no apply yet).
-        accepted, version = client.push_gradients(g1, {}, version=0)
+        accepted, version = w1.push_gradients(g1, {}, version=0)
         assert accepted and version == 0
-        _, _, params = client.pull_dense_parameters(["w"], version=0)
+        _, _, params = w1.pull_dense_parameters(["w"], version=0)
         np.testing.assert_array_equal(params["w"], [0.0, 0.0])
-        # Second push reaches quorum: applies the average, version bumps.
-        accepted, version = client.push_gradients(g2, {}, version=0)
+        # Second worker reaches quorum: applies the average, version bumps.
+        accepted, version = w2.push_gradients(g2, {}, version=0)
         assert accepted and version == 1
-        _, _, params = client.pull_dense_parameters(["w"], version=0)
+        _, _, params = w1.pull_dense_parameters(["w"], version=0)
         np.testing.assert_allclose(params["w"], [-2.0, -2.0])
         # A push computed against version 0 is now stale: rejected.
-        accepted, version = client.push_gradients(g1, {}, version=0)
+        accepted, version = w1.push_gradients(g1, {}, version=0)
         assert not accepted and version == 1
-        client.close()
+        w1.close()
+        w2.close()
     finally:
         server.stop()
 
