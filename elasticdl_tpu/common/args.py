@@ -142,6 +142,14 @@ def add_cluster_arguments(parser):
     parser.add_argument("--max_relaunches", type=int, default=3)
     parser.add_argument("--master_port", type=int, default=50001)
     parser.add_argument(
+        "--multi_host",
+        action="store_true",
+        default=False,
+        help="AllReduce workers are separate processes/hosts forming one "
+        "jax.distributed SPMD world; training is driven by "
+        "step-synchronized task leases",
+    )
+    parser.add_argument(
         "--coordinator_port",
         type=int,
         default=51000,

@@ -39,6 +39,8 @@ MASTER_SERVICE = ServiceSpec(
         "report_evaluation_metrics": (pb.ReportEvaluationMetricsRequest, pb.Empty),
         "report_version": (pb.ReportVersionRequest, pb.Empty),
         "get_comm_rank": (pb.GetCommRankRequest, pb.GetCommRankResponse),
+        "lease_steps": (pb.LeaseStepsRequest, pb.LeaseStepsResponse),
+        "report_lease": (pb.ReportLeaseRequest, pb.Empty),
         "report_worker_liveness": (pb.ReportWorkerLivenessRequest, pb.Empty),
         "get_job_status": (pb.GetJobStatusRequest, pb.JobStatusResponse),
     },

@@ -41,6 +41,7 @@ _WORKER_RELAY_ARGS = [
     "log_loss_steps",
     "seed",
     "model_parallel_size",
+    "multi_host",
     "training_data",
     "validation_data",
     "prediction_data",
@@ -154,11 +155,21 @@ class Master:
             # Arm the final export task (reference: SavedModel export via a
             # train-end callback task, master/callbacks.py:38-66).
             self.task_d.enable_train_end_task()
+        self.step_leases = None
+        if self.membership is not None and getattr(
+            args, "multi_host", False
+        ):
+            from elasticdl_tpu.master.step_lease import StepLeaseManager
+
+            self.step_leases = StepLeaseManager(
+                self.task_d, self.membership
+            )
         self.servicer = MasterServicer(
             self.task_d,
             self.evaluation_service,
             self.membership,
             worker_liveness_timeout=args.worker_liveness_timeout_seconds,
+            step_lease_manager=self.step_leases,
         )
         self._server = None
         self.port = None
@@ -382,7 +393,17 @@ class Master:
 
     def _run_watchdog(self):
         """Task-timeout + liveness watchdog (reference master.py:487-509)."""
-        slow = self.task_d.doing_tasks_over_timeout()
+        from elasticdl_tpu.master.step_lease import is_lease_owner
+
+        # Synthetic lease owners are excluded: lease lifetime is governed
+        # by membership epochs (step_lease.py aborts stale leases), and a
+        # watchdog recovery here would yank tasks out from under a live
+        # world mid-lease.
+        slow = {
+            wid
+            for wid in self.task_d.doing_tasks_over_timeout()
+            if not is_lease_owner(wid)
+        }
         deadline = (
             time.time() - self.args.worker_liveness_timeout_seconds
         )

@@ -96,12 +96,17 @@ class MembershipManager:
         with self._lock:
             rank = self._hosts.index(host) if host in self._hosts else -1
             coordinator = self._hosts[0] if self._hosts else ""
+            # Rotate the coordination-service port across epochs: the new
+            # rank-0 process re-binds immediately after a teardown, and a
+            # fixed port can linger in TIME_WAIT (or still be held by a
+            # dying former coordinator).
+            port = self._coordinator_port + (self._group_id % 16)
             return (
                 rank,
                 len(self._hosts),
                 self._group_id,
                 coordinator,
-                self._coordinator_port,
+                port,
             )
 
     @property

@@ -24,10 +24,12 @@ class MasterServicer:
         evaluation_service=None,
         membership=None,
         worker_liveness_timeout=60.0,
+        step_lease_manager=None,
     ):
         self._task_d = task_dispatcher
         self._evaluation_service = evaluation_service
         self._membership = membership
+        self._step_leases = step_lease_manager
         # Same threshold the master watchdog uses, so alive_workers in the
         # job status can't contradict actual liveness decisions.
         self._worker_liveness_timeout = worker_liveness_timeout
@@ -117,6 +119,28 @@ class MasterServicer:
             coordinator_addr=coordinator,
             rendezvous_port=coordinator_port,
         )
+
+    def lease_steps(self, request, context):
+        self._touch(request.worker_id)
+        if self._step_leases is None:
+            raise ValueError(
+                "step leases are only served for the multi-host AllReduce "
+                "strategy"
+            )
+        return self._step_leases.lease_steps(
+            request.worker_id, request.worker_host, request.batch_size
+        )
+
+    def report_lease(self, request, context):
+        self._touch(request.worker_id)
+        if self._step_leases is not None:
+            self._step_leases.report_lease(
+                request.lease_id,
+                request.rank,
+                request.success,
+                request.err_message,
+            )
+        return pb.Empty()
 
     def get_job_status(self, request, context):
         """Telemetry for `edl top` and other monitors (the in-job analog of

@@ -214,16 +214,25 @@ class TaskDispatcher:
 
     # ---------- worker-facing operations ----------
 
+    def _roll_epoch_locked(self, drained):
+        """One epoch-rollover state machine for both pop paths: when the
+        caller-supplied drain condition holds and epochs remain, generate
+        the next epoch's (shuffled) training tasks."""
+        if (
+            drained
+            and not self._stop_training
+            and self._epoch < self._num_epochs
+            and self._training_shards
+        ):
+            logger.info("Starting epoch %d", self._epoch)
+            self._epoch += 1
+            self._create_tasks_locked(pb.TRAINING)
+
     def get(self, worker_id):
         """Pop the next task for a worker; () epoch rollover when the
         training queue drains. Returns (task_id, _Task) or (-1, None)."""
         with self._lock:
-            if not self._todo and not self._stop_training and (
-                self._epoch < self._num_epochs and self._training_shards
-            ):
-                logger.info("Starting epoch %d", self._epoch)
-                self._epoch += 1
-                self._create_tasks_locked(pb.TRAINING)
+            self._roll_epoch_locked(not self._todo)
             if not self._todo:
                 return -1, None
             task = self._todo.popleft()
@@ -235,9 +244,20 @@ class TaskDispatcher:
     def get_eval_task(self, worker_id):
         """Pop the first EVALUATION task only (reference
         task_dispatcher.py:272-297)."""
+        return self.get_typed(worker_id, pb.EVALUATION)
+
+    def get_typed(self, worker_id, task_type):
+        """Pop the first task of one type only. For TRAINING this also
+        rolls the epoch when the training queue drains (the step-lease
+        manager consumes training work through here while evaluation tasks
+        stay available to get_eval_task)."""
         with self._lock:
+            if task_type == pb.TRAINING:
+                self._roll_epoch_locked(
+                    not any(t.type == pb.TRAINING for t in self._todo)
+                )
             for i, task in enumerate(self._todo):
-                if task.type == pb.EVALUATION:
+                if task.type == task_type:
                     del self._todo[i]
                     task_id = self._next_task_id
                     self._next_task_id += 1
@@ -295,6 +315,46 @@ class TaskDispatcher:
                 cb()
         return task
 
+    def fail_owner_tasks(self, owner_id, err_message=""):
+        """Requeue every in-flight task of an owner THROUGH the retry
+        ladder (unlike recover_tasks, which requeues for free). Used for
+        fault-attributed lease aborts: a deterministic per-range failure
+        must exhaust max_task_retries and fail the job, exactly as the
+        same error would on the non-lease path, instead of relenting
+        forever."""
+        failed = []
+        with self._lock:
+            ids = [
+                tid
+                for tid, (wid, _, _) in self._doing.items()
+                if wid == owner_id
+            ]
+            for tid in ids:
+                _, task, _ = self._doing.pop(tid)
+                if self._stop_training and task.type == pb.TRAINING:
+                    continue
+                task.retry_count += 1
+                if task.retry_count > self._max_task_retries:
+                    failed.append(task)
+                    self._job_failed = True
+                    self._todo.clear()
+                else:
+                    self._todo.appendleft(task)
+        for task in failed:
+            logger.error(
+                "Task %s failed %d times (last: %s); failing job",
+                task,
+                task.retry_count,
+                err_message,
+            )
+        if ids and not failed:
+            logger.warning(
+                "Re-queueing %d failed tasks of owner %d (%s)",
+                len(ids),
+                owner_id,
+                err_message,
+            )
+
     def recover_tasks(self, worker_id):
         """Re-queue every in-flight task owned by a dead worker (reference
         task_dispatcher.py:365-377). Called by the instance manager on pod
@@ -334,6 +394,27 @@ class TaskDispatcher:
             logger.info("Dispatching train-end callback task")
             return False
         return done
+
+    def training_exhausted(self):
+        """True when no TRAINING task exists or can ever appear again (todo
+        and doing are training-free and the epochs are spent). Once true it
+        stays true: new training tasks come only from epoch rollover or
+        from requeueing in-flight ones. The lease loop exits on this rather
+        than on finished(), which stays False while evaluation/train-end
+        work remains."""
+        with self._lock:
+            if any(t.type == pb.TRAINING for t in self._todo):
+                return False
+            if any(
+                task.type == pb.TRAINING
+                for (_, task, _) in self._doing.values()
+            ):
+                return False
+            return (
+                not self._training_shards
+                or self._epoch >= self._num_epochs
+                or self._stop_training
+            )
 
     def finished(self):
         # NB: after stop_training() this still waits for in-flight tasks and

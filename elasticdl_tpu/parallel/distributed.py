@@ -34,6 +34,29 @@ _current = {
     "live": False,
 }
 
+# Elasticity-tuned timeouts. The shutdown barrier is best-effort: after a
+# peer SIGKILL the survivors' barrier can never complete, so it must fail
+# fast (and be swallowed) rather than hold up the re-mesh for the default
+# 300 s. Heartbeat stays above the gloo collective timeout (~30 s) so an
+# in-flight collective surfaces a catchable step error before the
+# coordination client's process-killing health check fires.
+INITIALIZATION_TIMEOUT_SECONDS = 120
+SHUTDOWN_TIMEOUT_SECONDS = 10
+HEARTBEAT_TIMEOUT_SECONDS = 60
+
+
+def _shutdown_quietly():
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        # Failed shutdown barrier (dead peer) or an already-errored
+        # coordination client: the world is being abandoned either way.
+        logger.warning(
+            "Distributed shutdown was not clean (peer death is the usual "
+            "cause); proceeding with teardown",
+            exc_info=True,
+        )
+
 
 def ensure_world(coordinator_addr, world_size, rank, epoch=None):
     """(Re)join the distributed world described by the triple. No-ops only
@@ -54,7 +77,7 @@ def ensure_world(coordinator_addr, world_size, rank, epoch=None):
         return
     if _current["live"]:
         logger.info("Leaving distributed world %s", _current)
-        jax.distributed.shutdown()
+        _shutdown_quietly()
         _current["live"] = False
         # Drop the cached backends so the old world's device topology
         # can't leak into world_size<=1 callers; the join path below also
@@ -92,6 +115,9 @@ def ensure_world(coordinator_addr, world_size, rank, epoch=None):
         coordinator_address=coordinator_addr,
         num_processes=world_size,
         process_id=rank,
+        initialization_timeout=INITIALIZATION_TIMEOUT_SECONDS,
+        shutdown_timeout_seconds=SHUTDOWN_TIMEOUT_SECONDS,
+        heartbeat_timeout_seconds=HEARTBEAT_TIMEOUT_SECONDS,
     )
     _current.update(
         coordinator=coordinator_addr,
@@ -104,5 +130,5 @@ def ensure_world(coordinator_addr, world_size, rank, epoch=None):
 
 def leave_world():
     if _current["live"]:
-        jax.distributed.shutdown()
+        _shutdown_quietly()
         _current["live"] = False

@@ -110,6 +110,7 @@ class AllReduceTrainer(JaxTrainer):
         self._world_size = 0
         self._mesh = None
         self._sharded_steps = {}  # real_n -> jitted step
+        self._local_forward = None  # multi-host eval path, built lazily
         self._steps_since_check = 0
         # Guards the (variables, opt_state, version) triple: the broadcast
         # server reads it from gRPC threads while the training thread swaps
@@ -138,6 +139,11 @@ class AllReduceTrainer(JaxTrainer):
     @property
     def world_size(self):
         return self._world_size
+
+    @property
+    def group_id(self):
+        """Membership epoch this trainer last joined."""
+        return self._group_id
 
     def restore_variables(self, exported):
         # The broadcast server reads (variables, opt_state, version) from
@@ -204,7 +210,17 @@ class AllReduceTrainer(JaxTrainer):
             )
         self._mesh = self._make_world_mesh()
         self._sharded_steps = {}
-        if self._rank != 0 and resp.coordinator_addr:
+        self._local_forward = None  # compiled against the torn-down backend
+        if self._multi_host and jax.process_count() > 1:
+            # SPMD world: sync state through an on-mesh collective that
+            # EVERY member executes right after the rendezvous, instead of
+            # a host gRPC pull. The pull deadlocks here: rank 0's device
+            # stream can already be blocked inside the new world's first
+            # collective, so its broadcast server can't serve device reads
+            # (single-process-world regroups keep the gRPC path below —
+            # they have no shared world to collective over).
+            host_state = self._sync_state_over_world(host_state)
+        elif self._rank != 0 and resp.coordinator_addr:
             pulled = self._pull_from_rank0(resp.coordinator_addr)
             if pulled is not None:
                 host_state = pulled
@@ -230,6 +246,56 @@ class AllReduceTrainer(JaxTrainer):
                 self._variables = None
                 self._opt_state = None
         self._group_id = resp.rendezvous_id
+
+    def _sync_state_over_world(self, host_state):
+        """Collective state broadcast from (new-world) rank 0: the TPU-first
+        analog of the reference's `broadcast_variables(rank 0)` after a
+        Horovod re-rendezvous (allreduce_trainer.py:150-152), expressed as
+        XLA collectives over the fresh mesh rather than host RPC. Every
+        process contributes its snapshot (zeros when it has none — a fresh
+        joiner initialized params from data just for the shapes) and
+        receives rank 0's (variables, opt_state, version) triple."""
+        from jax.experimental import multihost_utils
+
+        if host_state is None:
+            # Poisoned local state (unreadable device buffers). The
+            # broadcast is a collective, so this process must still
+            # participate — with a zero template of the right shapes it
+            # receives rank 0's state like any joiner. Without variables
+            # at all there are no shapes to offer; every member hits the
+            # same branch only at cold start, where data re-seed follows.
+            if self._variables is None:
+                return None
+            variables = jax.tree_util.tree_map(
+                lambda a: np.zeros(a.shape, a.dtype), self._variables
+            )
+            opt_state = jax.tree_util.tree_map(
+                lambda a: np.zeros(
+                    getattr(a, "shape", ()), getattr(a, "dtype", np.float32)
+                ),
+                self._opt_state,
+            )
+            host_state = (variables, opt_state, 0)
+        variables, opt_state, version = host_state
+        is_source = jax.process_index() == 0
+        synced_vars, synced_opt, synced_version = (
+            multihost_utils.broadcast_one_to_all(
+                (variables, opt_state, np.int64(version)),
+                is_source=is_source,
+            )
+        )
+        version = int(synced_version)
+        logger.info(
+            "Collective state sync complete (version %d, source rank 0, "
+            "this rank %d)",
+            version,
+            self._rank,
+        )
+        return (
+            jax.tree_util.tree_map(np.asarray, synced_vars),
+            jax.tree_util.tree_map(np.asarray, synced_opt),
+            version,
+        )
 
     def _pull_from_rank0(self, coordinator_addr):
         if self._variables is None:
@@ -466,6 +532,17 @@ class AllReduceTrainer(JaxTrainer):
                 time.sleep(min(3, 0.1 * 2**attempt))
                 self.init_world_if_needed(force=True)
 
+    def train_lease_minibatch(self, features, labels):
+        """One SPMD step with NO world check and NO internal retry: in
+        step-lease mode every member of the world must dispatch exactly the
+        same step sequence, so recovery decisions belong to the lease loop
+        (which abandons the lease and re-rendezvouses), not to a per-step
+        retry that would desynchronize this rank from its peers."""
+        self.init_variables_if_needed(features)
+        features = jax.tree_util.tree_map(np.asarray, features)
+        labels = jax.tree_util.tree_map(np.asarray, labels)
+        return self._run_sharded_step(features, labels)
+
     def _run_sharded_step(self, features, labels):
         n_data = self._mesh.shape["data"]
         padded_f, real_n = pad_batch_to_multiple(features, n_data)
@@ -492,6 +569,29 @@ class AllReduceTrainer(JaxTrainer):
             self._opt_state = new_opt_state
             self._version += 1
         return loss
+
+    def evaluate_minibatch(self, features, model_version=-1):
+        if jax.process_count() <= 1:
+            return super().evaluate_minibatch(features, model_version)
+        # Same lazy-init guard as the base path: a relaunched worker can
+        # draw an evaluation task before its first training lease.
+        self.init_variables_if_needed(features)
+        # Multi-host: the training variables live sharded across the global
+        # mesh, but evaluation tasks are dispatched to ONE worker — a
+        # global-mesh forward would need every process to participate.
+        # Pull a host copy and run the forward on this process's local
+        # devices only (eval is forward-only and rare; the copy is cheap
+        # next to a lease of training steps).
+        with self._state_lock:
+            host_vars = jax.device_get(self._variables)
+        if self._local_forward is None:
+            self._local_forward = jax.jit(
+                lambda v, f: self._model.apply(v, f, training=False)
+            )
+        outputs = self._local_forward(
+            host_vars, jax.tree_util.tree_map(np.asarray, features)
+        )
+        return jax.tree_util.tree_map(np.asarray, outputs)
 
     def close(self):
         self._broadcast_server.stop()

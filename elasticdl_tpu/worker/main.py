@@ -124,8 +124,22 @@ def main(argv=None):
         profile_dir=profile_dir,
         profile_start_step=args.profile_start_step,
         profile_steps=args.profile_steps,
+        # Multi-host AllReduce trains through step-synchronized leases:
+        # every process of the SPMD world must run the same step count.
+        lease_mode=(
+            args.distribution_strategy == DistributionStrategy.ALLREDUCE
+            and args.multi_host
+        ),
     )
-    worker.run()
+    try:
+        worker.run()
+    finally:
+        # Leave any distributed world deterministically: interpreter-exit
+        # shutdown from N processes at scattered times fails the shutdown
+        # barrier and crashes the slowest peer.
+        close = getattr(trainer, "close", None)
+        if close is not None:
+            close()
     logger.info("Worker %d exiting", args.worker_id)
     return 0
 

@@ -66,6 +66,26 @@ class MasterClient:
             pb.GetCommRankRequest(worker_host=self._worker_host)
         )
 
+    def lease_steps(self, batch_size):
+        return self._stub.lease_steps(
+            pb.LeaseStepsRequest(
+                worker_id=self._worker_id,
+                worker_host=self._worker_host,
+                batch_size=batch_size,
+            )
+        )
+
+    def report_lease(self, lease_id, rank, success, err_message=""):
+        return self._stub.report_lease(
+            pb.ReportLeaseRequest(
+                lease_id=lease_id,
+                worker_id=self._worker_id,
+                rank=rank,
+                success=success,
+                err_message=err_message,
+            )
+        )
+
     def report_liveness(self):
         return self._stub.report_worker_liveness(
             pb.ReportWorkerLivenessRequest(

@@ -54,6 +54,12 @@ class TaskDataService:
         if batch:
             yield batch
 
+    def read_range(self, lease_range):
+        """All records of one lease sub-range (LeaseRange carries the same
+        shard_name/start/end attributes a Task does, so readers take it
+        as-is)."""
+        return list(self._reader.read_records(lease_range))
+
     def report_task(self, task_id, err_message="", exec_counters=None):
         self._mc.report_task_result(task_id, err_message, exec_counters)
 

@@ -37,6 +37,7 @@ class Worker:
         profile_dir="",
         profile_start_step=10,
         profile_steps=5,
+        lease_mode=False,
     ):
         self._worker_id = worker_id
         self._mc = master_client
@@ -48,6 +49,9 @@ class Worker:
         self._log_loss_steps = log_loss_steps
         self._max_minibatch_retries = max_minibatch_retries
         self._metadata = data_reader.metadata
+        # Step-synchronized lease mode (multi-host AllReduce): training is
+        # driven by whole-world leases instead of independent task pulls.
+        self._lease_mode = lease_mode
         self._steps = 0
         self._timing = Timing()
         # One-shot device trace of steady-state steps (past the compile):
@@ -82,6 +86,10 @@ class Worker:
                 JobType.TRAINING_ONLY,
                 JobType.TRAINING_WITH_EVALUATION,
             ):
+                if self._lease_mode:
+                    # Leases cover TRAINING work only; the regular loop
+                    # afterwards drains evaluation and train-end tasks.
+                    self._train_leases()
                 self._train_and_evaluate()
             elif self._job_type == JobType.EVALUATION_ONLY:
                 self._evaluate_only()
@@ -119,6 +127,132 @@ class Worker:
             else:
                 logger.warning("Skipping unexpected task %s", task)
                 self._tds.report_task(task.task_id)
+
+    def _train_leases(self):
+        """Step-synchronized lease loop (multi-host AllReduce): every rank
+        of the current membership epoch runs exactly lease.n_steps SPMD
+        minibatches, then the lease's tasks complete; a comm failure or a
+        membership change abandons the lease (the master requeues it). The
+        loop returns when training work is exhausted — evaluation and
+        train-end tasks drain through the regular task loop after."""
+        import time as _time
+
+        import jax
+
+        while True:
+            lease = self._mc.lease_steps(self._minibatch_size)
+            if lease.status == pb.LeaseStepsResponse.FINISHED:
+                logger.info(
+                    "Worker %d: training leases exhausted", self._worker_id
+                )
+                return
+            if lease.status == pb.LeaseStepsResponse.WAIT:
+                # Not in the group yet, peers still finishing the active
+                # lease, or no mintable work: announce ourselves, drain any
+                # pending evaluation work, and poll again.
+                self._mc.report_liveness()
+                if self._job_type == JobType.TRAINING_WITH_EVALUATION:
+                    self._drain_eval_tasks()
+                _time.sleep(0.5)
+                continue
+            try:
+                records = self._read_lease_records(lease.ranges)
+            except Exception as e:
+                logger.error("Lease %d data read failed: %s", lease.lease_id, e)
+                self._mc.report_lease(
+                    lease.lease_id, lease.rank, False, str(e)
+                )
+                continue
+            if not records:
+                self._mc.report_lease(
+                    lease.lease_id, lease.rank, False, "empty lease ranges"
+                )
+                continue
+            B = self._minibatch_size
+            first = self._spec.feed(
+                records[:B], Modes.TRAINING, self._metadata
+            )
+            self._trainer.init_variables_if_needed(first[0])
+            self._trainer.init_world_if_needed()
+            if (
+                self._trainer.group_id != lease.epoch
+                or self._trainer.rank != lease.rank
+                or self._trainer.world_size != lease.world_size
+            ):
+                # The world moved between minting and joining; the master
+                # aborts this lease on its next epoch observation.
+                logger.info(
+                    "Worker %d: lease %d is for epoch %d but this worker "
+                    "is at epoch %d (rank %d/%d); refetching",
+                    self._worker_id,
+                    lease.lease_id,
+                    lease.epoch,
+                    self._trainer.group_id,
+                    self._trainer.rank,
+                    self._trainer.world_size,
+                )
+                continue
+            try:
+                loss = None
+                for i in range(lease.n_steps):
+                    # Cycle this rank's records to fill every batch: all
+                    # ranks must dispatch identically-shaped steps.
+                    rows = [
+                        records[(i * B + j) % len(records)]
+                        for j in range(B)
+                    ]
+                    features, labels = self._spec.feed(
+                        rows, Modes.TRAINING, self._metadata
+                    )
+                    loss = self._trainer.train_lease_minibatch(
+                        features, labels
+                    )
+                    self._steps += 1
+                    if self._steps % self._log_loss_steps == 0:
+                        logger.info(
+                            "Step %d (lease %d) loss %.6f",
+                            self._steps,
+                            lease.lease_id,
+                            float(loss),
+                        )
+                # Async dispatch: a peer failure surfaces at
+                # materialization. Block before reporting so "success"
+                # means the steps actually ran.
+                if loss is not None:
+                    jax.block_until_ready(loss)
+            except Exception as e:
+                logger.warning(
+                    "Lease %d failed mid-steps; re-checking world",
+                    lease.lease_id,
+                    exc_info=True,
+                )
+                old_epoch = self._trainer.group_id
+                try:
+                    self._trainer.init_world_if_needed(force=True)
+                except Exception:
+                    logger.warning(
+                        "World re-init failed; will retry on next lease",
+                        exc_info=True,
+                    )
+                if self._trainer.group_id == old_epoch:
+                    # Same membership epoch: this was a deterministic
+                    # failure (bad feed, NaN'd compile, ...), not an
+                    # elastic event — report it so the master's retry
+                    # ladder can bound it instead of silently re-minting
+                    # the same doomed lease forever.
+                    self._mc.report_lease(
+                        lease.lease_id, lease.rank, False, str(e)
+                    )
+                    _time.sleep(0.5)
+                continue
+            self._mc.report_lease(lease.lease_id, lease.rank, True)
+            self._mc.report_version(self._trainer.get_model_version())
+
+    def _read_lease_records(self, ranges):
+        records = []
+        for r in ranges:
+            records.extend(self._tds.read_range(r))
+        return records
 
     def _evaluate_only(self):
         while True:

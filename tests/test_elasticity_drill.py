@@ -74,6 +74,52 @@ def test_kill_worker_mid_job_drill(tmp_path, strategy, num_ps):
     np.testing.assert_allclose(kernel, test_module.TRUE_W, atol=0.1)
 
 
+def test_kill_worker_mid_job_multihost_lease_drill(tmp_path):
+    """The ADR-5 capstone: TWO OS processes form ONE jax.distributed SPMD
+    world (4 virtual CPU devices each = 8-device global mesh), training
+    through step-synchronized task leases. SIGKILLing one worker mid-job
+    must shrink the world to the 4-device survivor, relaunch the worker,
+    grow back to 8, and complete with a converged model — the reference's
+    elastic Horovod behavior (allreduce/report.md) at full process
+    scope."""
+    from elasticdl_tpu.data.recordfile import RecordFileWriter
+
+    data = str(tmp_path / "linear.edlr")
+    with RecordFileWriter(data) as w:
+        for r in test_module.make_linear_records(256):
+            w.write(r)
+    output = str(tmp_path / "model.npz")
+    result = run_drill(
+        data,
+        model_zoo=os.path.join(REPO, "tests"),
+        model_def="test_module",
+        num_workers=2,
+        num_ps=0,
+        strategy="AllreduceStrategy",
+        num_epochs=120,
+        minibatch_size=32,
+        records_per_task=64,
+        extra_args=(
+            "--multi_host",
+            "--coordinator_port",
+            "53100",
+            "--output",
+            output,
+        ),
+        env_overrides={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        },
+        timeout=540,
+    )
+    assert result["completed"], result.get("log_tail", "")[-1500:]
+    assert result["relaunched"], "worker was never relaunched"
+    assert result["rejoin_s"] is not None, result
+    with np.load(output) as d:
+        kernel = d["params/Dense_0/kernel"].reshape(-1)
+    np.testing.assert_allclose(kernel, test_module.TRUE_W, atol=0.1)
+
+
 _MH_CHILD = textwrap.dedent(
     """
     import sys, os
