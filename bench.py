@@ -152,6 +152,93 @@ def bench_deepfm_criteo(batch_size=32768, steps=30, warmup=5):
     }
 
 
+def bench_deepfm_ps(batch_size=32768, steps=12, warmup=3, num_ps=2):
+    """The other half of the DeepFM north star (BASELINE.json: "large
+    embedding_service + elastic worker preemption"): DeepFM with its
+    embedding tables PS-RESIDENT on 2 real localhost PS shards (native
+    C++ kernels), one TPU worker pulling rows / pushing IndexedSlices
+    per step. Measured both ways: the pipelined async path (push on a
+    background thread, pulls overlapping the previous step's device
+    compute) vs the fully serialized loop — the before/after of the
+    round-3 overlap work."""
+    from elasticdl_tpu.common.model_utils import get_model_spec
+    from elasticdl_tpu.models.dac_ctr.transform import NUM_FIELDS, TOTAL_IDS
+    from elasticdl_tpu.ps.parameter_server import ParameterServer
+    from elasticdl_tpu.worker.ps_client import PSClient
+    from elasticdl_tpu.worker.ps_trainer import ParameterServerTrainer
+
+    spec = get_model_spec("elasticdl_tpu.models.dac_ctr.deepfm")
+    rng = np.random.default_rng(0)
+    n_batches = 4  # distinct id sets so pulls stay realistic
+    batches = []
+    for _ in range(n_batches):
+        features = {
+            "dense": rng.normal(size=(batch_size, 13)).astype(np.float32),
+            "ids": rng.integers(
+                0, TOTAL_IDS, size=(batch_size, NUM_FIELDS)
+            ).astype(np.int32),
+        }
+        labels = rng.integers(0, 2, batch_size).astype(np.int64)
+        batches.append((features, labels))
+
+    out = {}
+    for mode, pipelined in (("serialized", False), ("pipelined", True)):
+        servers = [
+            ParameterServer(
+                i, num_ps, optimizer_spec=spec.build_optimizer_spec()
+            )
+            for i in range(num_ps)
+        ]
+        client = None
+        trainer = None
+        try:
+            client = PSClient(
+                [s.addr for s in servers], worker_id=0
+            )
+            trainer = ParameterServerTrainer(
+                spec.build_model(),
+                spec.loss,
+                spec.build_optimizer_spec(),
+                client,
+                pipeline_pushes=pipelined,
+            )
+            for i in range(warmup):
+                f, l = batches[i % n_batches]
+                trainer.train_minibatch(f, l)
+            trainer._flush_pushes()
+            trainer.timing.reset()
+            start = time.perf_counter()
+            loss = None
+            for i in range(steps):
+                f, l = batches[i % n_batches]
+                _, _, loss = trainer.train_minibatch(f, l)
+            float(loss)
+            trainer._flush_pushes()
+            elapsed = time.perf_counter() - start
+            phases = {
+                phase: round(s["mean_s"] * 1e3, 2)
+                for phase, s in trainer.timing.summary().items()
+            }
+            out[mode] = {
+                "examples_per_sec": batch_size * steps / elapsed,
+                "step_time_ms": elapsed / steps * 1e3,
+                "phase_mean_ms": phases,
+            }
+        finally:
+            if trainer is not None:
+                trainer.close()
+            if client is not None:
+                client.close()
+            for s in servers:
+                s.stop()
+    if out.get("serialized", {}).get("examples_per_sec"):
+        out["overlap_speedup"] = (
+            out["pipelined"]["examples_per_sec"]
+            / out["serialized"]["examples_per_sec"]
+        )
+    return out
+
+
 def bench_elastic_rejoin():
     """The third north-star metric (BASELINE.json): seconds for a job that
     loses a worker to SIGKILL to have its replacement back in the job
@@ -199,6 +286,10 @@ def main():
     resnet = bench_resnet50()
     mobilenet = bench_mobilenetv2()
     deepfm = bench_deepfm_criteo()
+    try:
+        deepfm_ps = bench_deepfm_ps()
+    except Exception as e:  # never let the PS bench sink the whole run
+        deepfm_ps = {"error": str(e)[:200]}
     elastic = bench_elastic_rejoin()
     # LocalTrainer's jitted step runs on exactly one device, so its
     # examples/sec IS the per-chip figure regardless of how many chips the
@@ -209,6 +300,7 @@ def main():
         "resnet50": {k: round(v, 4) for k, v in resnet.items()},
         "mobilenetv2": {k: round(v, 4) for k, v in mobilenet.items()},
         "deepfm_criteo": {k: round(v, 4) for k, v in deepfm.items()},
+        "deepfm_ps_mode": deepfm_ps,
         "deepfm_examples_per_sec_chip": round(
             deepfm["examples_per_sec"], 2
         ),

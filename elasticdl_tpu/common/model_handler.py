@@ -111,19 +111,33 @@ def _combined_zeros(module, ids):
 
 
 class PSWrappedModel(nn.Module):
-    """Wraps a user model, rerouting oversized `nn.Embed`s to the PS."""
+    """Wraps a user model, rerouting oversized `nn.Embed`s to the PS.
+
+    Placement tiers (device_capacity_bytes is the round-3 upper tier):
+      <= threshold_bytes                      replicate on device (stock)
+      (threshold, device_capacity]            stay on device — on a
+          multi-device mesh the trainer row-shards these over the mesh
+          (parallel/sharded_embedding.py) instead of re-hosting them
+      > device_capacity (or > threshold when no capacity is given)
+                                              PS-resident (host RPC)
+    """
 
     inner: nn.Module
     threshold_bytes: int = DEFAULT_THRESHOLD_BYTES
+    device_capacity_bytes: int = 0  # 0 = no device tier (legacy 2-tier)
 
     @nn.compact
     def __call__(self, *args, **kwargs):
         outer = self
         calls_seen = set()  # tables applied so far in THIS forward
 
+        ps_cutoff = max(
+            outer.threshold_bytes, outer.device_capacity_bytes
+        )
+
         def interceptor(next_fun, fargs, fkwargs, context):
             mod = context.module
-            if _oversized(mod, outer.threshold_bytes):
+            if _oversized(mod, ps_cutoff):
                 if context.method_name == "setup":
                     # The swap: never declare the giant table param.
                     return None
@@ -180,8 +194,13 @@ class PSWrappedModel(nn.Module):
             return self.inner(*args, **kwargs)
 
 
-def wrap_model_for_ps(model, threshold_bytes=DEFAULT_THRESHOLD_BYTES):
-    return PSWrappedModel(inner=model, threshold_bytes=threshold_bytes)
+def wrap_model_for_ps(model, threshold_bytes=DEFAULT_THRESHOLD_BYTES,
+                      device_capacity_bytes=0):
+    return PSWrappedModel(
+        inner=model,
+        threshold_bytes=threshold_bytes,
+        device_capacity_bytes=device_capacity_bytes,
+    )
 
 
 class _CaptureDistributed(nn.Module):

@@ -31,25 +31,55 @@ class CTREmbeddings(nn.Module):
 
     Returns (linear_logits [B, F(+1)], field_embs [B, F, D], dense [B, 13]):
     everything any head (wide&deep / FM / CIN / cross) consumes.
+
+    shard_mesh: when set, the tables' rows are DEVICE-SHARDED over that
+    mesh's `shard_axis` and looked up with on-chip collectives
+    (parallel/sharded_embedding.py) — the TPU-first middle tier for tables
+    that exceed one chip's HBM but fit the slice. Param names stay
+    "wide"/"deep" (vocab padded up to the axis size), so checkpoints
+    transfer between placements.
     """
 
     deep_dim: int = 8
     vocab: int = TOTAL_IDS
+    shard_mesh: object = None
+    shard_axis: str = "data"
 
     @nn.compact
     def __call__(self, features):
         ids = features["ids"].astype(jnp.int32)  # [B, F]
         dense = features["dense"].astype(jnp.float32)  # [B, 13]
+        vocab = self.vocab
+        if self.shard_mesh is not None:
+            from elasticdl_tpu.parallel.sharded_embedding import (
+                padded_vocab,
+            )
+
+            vocab = padded_vocab(
+                vocab, self.shard_mesh.shape[self.shard_axis]
+            )
         wide_table = self.param(
-            "wide", nn.initializers.zeros, (self.vocab, 1)
+            "wide", nn.initializers.zeros, (vocab, 1)
         )
         deep_table = self.param(
             "deep",
             nn.initializers.normal(stddev=0.01),
-            (self.vocab, self.deep_dim),
+            (vocab, self.deep_dim),
         )
-        linear = jnp.take(wide_table, ids, axis=0)[..., 0]  # [B, F]
-        field_embs = jnp.take(deep_table, ids, axis=0)  # [B, F, D]
+        if self.shard_mesh is not None:
+            from elasticdl_tpu.parallel.sharded_embedding import (
+                sharded_embedding_lookup,
+            )
+
+            linear = sharded_embedding_lookup(
+                wide_table, ids, self.shard_mesh, self.shard_axis
+            )[..., 0]
+            field_embs = sharded_embedding_lookup(
+                deep_table, ids, self.shard_mesh, self.shard_axis
+            )
+        else:
+            linear = jnp.take(wide_table, ids, axis=0)[..., 0]  # [B, F]
+            field_embs = jnp.take(deep_table, ids, axis=0)  # [B, F, D]
         dense_logit = nn.Dense(1, use_bias=False, name="dense_linear")(
             dense
         )  # [B, 1]

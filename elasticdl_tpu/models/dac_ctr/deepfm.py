@@ -23,11 +23,19 @@ from elasticdl_tpu.ops import optimizers
 class DeepFMCriteo(nn.Module):
     deep_dim: int = 8
     dnn_hidden_units: tuple = (16, 4)
+    vocab: int = None  # default: the full Criteo offset id space
+    shard_mesh: object = None  # device-shard the tables over this mesh
+    shard_axis: str = "data"
 
     @nn.compact
     def __call__(self, features, training: bool = False):
+        from elasticdl_tpu.models.dac_ctr.transform import TOTAL_IDS
+
         linear_logits, field_embs, dense = CTREmbeddings(
-            deep_dim=self.deep_dim
+            deep_dim=self.deep_dim,
+            vocab=self.vocab or TOTAL_IDS,
+            shard_mesh=self.shard_mesh,
+            shard_axis=self.shard_axis,
         )(features)
         fm = fm_interaction(field_embs)  # [B]
         dnn_input = jnp.concatenate(
@@ -43,6 +51,30 @@ class DeepFMCriteo(nn.Module):
 
 def custom_model():
     return DeepFMCriteo()
+
+
+def custom_sharded_model(mesh, axis="data", vocab=None):
+    """DeepFM with DEVICE-SHARDED embedding tables: rows across the mesh,
+    lookups by on-chip collectives (parallel/sharded_embedding.py) — how
+    this framework beats the reference's embedding_service when the
+    tables fit the slice's aggregate HBM instead of re-hosting them."""
+    return DeepFMCriteo(shard_mesh=mesh, shard_axis=axis, vocab=vocab)
+
+
+def sharded_param_specs(params, axis="data"):
+    """PartitionSpecs for custom_sharded_model: the two tables row-sharded
+    over `axis`, everything else replicated (feed through NamedSharding
+    for jit in_shardings)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def spec(path, _):
+        names = [str(getattr(k, "key", k)) for k in path]
+        if names[-1] in ("wide", "deep"):
+            return P(axis, None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
 
 
 loss = ctr_loss

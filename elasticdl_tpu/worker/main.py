@@ -40,6 +40,9 @@ def build_trainer(args, spec, master_client):
             embedding_threshold_bytes=getattr(
                 spec.module, "embedding_threshold_bytes", None
             ),
+            embedding_device_capacity_bytes=getattr(
+                spec.module, "embedding_device_capacity_bytes", 0
+            ),
             seed=args.seed,
         )
     if strategy == DistributionStrategy.ALLREDUCE:

@@ -46,17 +46,39 @@ class ParameterServerTrainer(JaxTrainer):
         ps_client,
         embedding_inputs=None,
         embedding_threshold_bytes=None,
+        embedding_device_capacity_bytes=0,
         use_async=True,
         max_push_retries=DEFAULT_MAX_PUSH_RETRIES,
         seed=0,
+        pipeline_pushes=None,
     ):
         super().__init__(model, loss_fn, optimizer_spec, seed=seed)
         self._ps = ps_client
+        # Pipelined pushes (async SGD only): the gradient device_get +
+        # partition + RPC runs on a background thread while this thread
+        # pulls/prefetches the NEXT batch — so the per-step critical path
+        # is max(device_step, rpc) instead of their sum. One push in
+        # flight keeps ordering and bounds the extra staleness at one
+        # version (the same delay another worker's concurrent push would
+        # cause; async SGD absorbs it by design). Sync mode keeps the
+        # inline path: its stale-rejection handshake must complete before
+        # the next pull.
+        if pipeline_pushes is None:
+            pipeline_pushes = use_async
+        self._pipeline_pushes = pipeline_pushes and use_async
+        self._push_executor = None
+        self._push_future = None
         # callable(features) -> {table_name: ids ndarray}. Optional: when
         # omitted, the ModelHandler auto-swaps oversized nn.Embed tables
         # to the PS and derives the feed by id capture (init below).
         self._embedding_inputs = embedding_inputs
         self._embedding_threshold_bytes = embedding_threshold_bytes
+        # Upper placement tier: tables at or under this stay DEVICE-side
+        # (row-sharded over the mesh on multi-device runs) instead of
+        # PS-resident — see PSWrappedModel's tier table.
+        self._embedding_device_capacity_bytes = (
+            embedding_device_capacity_bytes
+        )
         self._use_async = use_async
         self._max_push_retries = max_push_retries
         self._param_names = None
@@ -93,6 +115,9 @@ class ParameterServerTrainer(JaxTrainer):
                 self._model,
                 self._embedding_threshold_bytes
                 or DEFAULT_THRESHOLD_BYTES,
+                device_capacity_bytes=(
+                    self._embedding_device_capacity_bytes
+                ),
             )
             with discover_tables() as discovered:
                 super().init_variables_if_needed(features)
@@ -239,8 +264,43 @@ class ParameterServerTrainer(JaxTrainer):
 
     # ---------- Trainer interface ----------
 
+    def _push_payload(self, param_grads, emb_grads, flat_ids, version,
+                      batch_size):
+        """Materialize grads off-device, partition, and push. Runs inline
+        (sync mode) or on the push thread (pipelined async mode), where
+        the device_get doubles as the wait for the step's compute."""
+        with self.timing.record("push_gradients"):
+            dense_named, _ = flatten_params(jax.device_get(param_grads))
+            sparse = {}
+            for path, g in _walk_dict(emb_grads):
+                table = path[-1]
+                sparse[table] = (
+                    np.asarray(g).reshape(
+                        -1, self._embedding_dims[table]
+                    ),
+                    flat_ids[table],
+                )
+            accepted, version = self._ps.push_gradients(
+                dense_named,
+                sparse,
+                version=version,
+                batch_size=batch_size,
+            )
+        self._version = max(self._version, version)
+        return accepted, version
+
+    def _flush_pushes(self):
+        """Wait for the in-flight background push (read-your-writes for
+        eval/export pulls; also the error-propagation point — a failed
+        push raises here and the worker's retry machinery takes over)."""
+        future, self._push_future = self._push_future, None
+        if future is not None:
+            future.result()
+
     def train_minibatch(self, features, labels):
         self.init_variables_if_needed(features)
+        if self._pipeline_pushes:
+            return self._train_minibatch_pipelined(features, labels)
         device_features = _to_device_batch(features)
         device_labels = _to_device_batch(labels)
         for attempt in range(self._max_push_retries):
@@ -262,26 +322,13 @@ class ParameterServerTrainer(JaxTrainer):
                     device_labels,
                 )
             self._variables.update(new_state)
-            with self.timing.record("push_gradients"):
-                dense_named, _ = flatten_params(
-                    jax.device_get(param_grads)
-                )
-                sparse = {}
-                for path, g in _walk_dict(emb_grads):
-                    table = path[-1]
-                    sparse[table] = (
-                        np.asarray(g).reshape(
-                            -1, self._embedding_dims[table]
-                        ),
-                        flat_ids[table],
-                    )
-                accepted, version = self._ps.push_gradients(
-                    dense_named,
-                    sparse,
-                    version=self._version,
-                    batch_size=int(np.asarray(labels).shape[0]),
-                )
-            self._version = max(self._version, version)
+            accepted, _ = self._push_payload(
+                param_grads,
+                emb_grads,
+                flat_ids,
+                self._version,
+                int(np.asarray(labels).shape[0]),
+            )
             if accepted:
                 return True, self._version, float(loss)
             logger.info(
@@ -290,8 +337,57 @@ class ParameterServerTrainer(JaxTrainer):
             )
         return False, self._version, float(loss)
 
+    def _train_minibatch_pipelined(self, features, labels):
+        """Async-SGD step with the push off the critical path: while the
+        device still computes step N, this thread already pulls params and
+        prefetches embeddings for step N+1 — the reference's hot loop
+        serializes a pull, a mid-forward lookup RPC, the step, and the
+        push (ps_trainer.py:372-401)."""
+        import concurrent.futures
+
+        if self._push_executor is None:
+            self._push_executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="edl-ps-push"
+            )
+        device_features = _to_device_batch(features)
+        device_labels = _to_device_batch(labels)
+        # These RPCs overlap the PREVIOUS step's device compute.
+        with self.timing.record("pull_model"):
+            self._sync_model()
+        with self.timing.record("prefetch_embeddings"):
+            emb_rows, flat_ids = self._prefetch_embeddings(features)
+        self._rng, step_rng = jax.random.split(self._rng)
+        state = {
+            k: v for k, v in self._variables.items() if k != "params"
+        }
+        with self.timing.record("train_step_dispatch"):
+            loss, param_grads, emb_grads, new_state = self._ps_step(
+                self._variables["params"],
+                state,
+                emb_rows,
+                step_rng,
+                device_features,
+                device_labels,
+            )
+        self._variables.update(new_state)
+        # One push in flight: wait out the previous (raising its errors),
+        # then hand this step's grads to the push thread. Its device_get
+        # blocks there until the step's compute finishes.
+        self._flush_pushes()
+        self._push_future = self._push_executor.submit(
+            self._push_payload,
+            param_grads,
+            emb_grads,
+            flat_ids,
+            self._version,
+            int(np.asarray(labels).shape[0]),
+        )
+        # Lazy loss: materializing here would re-serialize the pipeline.
+        return True, self._version, loss
+
     def evaluate_minibatch(self, features, model_version=-1):
         self.init_variables_if_needed(features)
+        self._flush_pushes()  # read-your-writes for the eval pull
         self._sync_model()
         emb_rows, _ = self._prefetch_embeddings(features)
         state = {k: v for k, v in self._variables.items() if k != "params"}
@@ -306,6 +402,14 @@ class ParameterServerTrainer(JaxTrainer):
     def get_model_version(self):
         return self._version
 
+    def close(self):
+        try:
+            self._flush_pushes()
+        finally:
+            if self._push_executor is not None:
+                self._push_executor.shutdown(wait=True)
+                self._push_executor = None
+
     def export_variables(self):
         """Export with the reverse swap (reference model_handler.py:242-268):
         pull final dense params AND full embedding tables from the PS, stuff
@@ -314,6 +418,7 @@ class ParameterServerTrainer(JaxTrainer):
         the checkpoint loads into the user's stock model."""
         if self._variables is None:
             return None
+        self._flush_pushes()  # the export must include the last push
         self._sync_model()
         variables = jax.device_get(dict(self._variables))
         params = variables["params"]

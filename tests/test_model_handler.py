@@ -47,6 +47,36 @@ def test_wrap_swaps_only_oversized_tables():
     assert emb["item_emb"].shape == (8 * auto_mod.IDS_PER_EXAMPLE, 4)
 
 
+def test_device_capacity_upper_tier():
+    """Round-3 tier: tables above the PS threshold but within the device
+    capacity stay on device (to be row-sharded over the mesh); only
+    tables beyond the capacity go to the PS."""
+    feats, _ = _sample_features()
+    # item table = 20*4*4 = 320 B, flag table = 3*2*4 = 24 B. With a
+    # 64 B threshold but a 1 KiB device capacity, NOTHING swaps...
+    model = wrap_model_for_ps(
+        auto_mod.custom_model(),
+        threshold_bytes=64,
+        device_capacity_bytes=1024,
+    )
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, feats, training=False
+    )
+    params = variables["params"]["inner"]
+    assert params["item_emb"]["embedding"].shape == (20, 4)
+    assert EMBEDDING_COLLECTION not in variables
+    # ...while a 128 B capacity sends only the item table to the PS.
+    model = wrap_model_for_ps(
+        auto_mod.custom_model(),
+        threshold_bytes=64,
+        device_capacity_bytes=128,
+    )
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, feats, training=False
+    )
+    assert set(variables[EMBEDDING_COLLECTION]) == {"item_emb"}
+
+
 def test_derive_embedding_inputs_exact_and_column():
     model = wrap_model_for_ps(
         auto_mod.custom_model(), threshold_bytes=64

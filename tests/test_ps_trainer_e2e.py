@@ -161,3 +161,44 @@ def test_ps_restart_reseed_mid_training():
     finally:
         for s in servers:
             s.stop()
+
+
+def test_ps_pipelined_pushes_converge_and_flush():
+    """The round-3 overlap path: pushes ride a background thread (one in
+    flight) while the next pull/prefetch runs — async SGD with at most
+    one extra version of staleness. Must still converge, and eval/export
+    must flush (read-your-writes) so they see the final push."""
+    spec = get_model_spec("embedding_test_module")
+    servers, addrs = start_pservers(2, spec)
+    try:
+        records = embedding_test_module.make_records(256)
+        reader = InMemoryReader(records)
+        trainer = ParameterServerTrainer(
+            spec.build_model(),
+            spec.loss,
+            spec.build_optimizer_spec(),
+            PSClient(addrs, worker_id=0),
+            embedding_inputs=spec.module.embedding_inputs,
+            pipeline_pushes=True,
+        )
+        assert trainer._pipeline_pushes
+        rng = np.random.default_rng(0)
+        losses = []
+        for _ in range(60):
+            idx = rng.integers(0, len(records), size=16)
+            f, l = spec.feed([records[i] for i in idx], "training", None)
+            ok, _, loss = trainer.train_minibatch(f, l)
+            assert ok
+            losses.append(loss)
+        # Lazy losses: materialize only now.
+        first = float(np.mean([float(x) for x in losses[:10]]))
+        last = float(np.mean([float(x) for x in losses[-10:]]))
+        assert last < first * 0.7, (first, last)
+        # export flushes the in-flight push before pulling tables.
+        exported = trainer.export_variables()
+        assert exported is not None
+        assert trainer._push_future is None
+        trainer.close()
+    finally:
+        for s in servers:
+            s.stop()

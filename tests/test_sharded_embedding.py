@@ -1,0 +1,195 @@
+"""Device-sharded embedding tables (parallel/sharded_embedding.py): rows
+block-sharded over the mesh, lookup by all_gather(ids) + local gather +
+psum_scatter — the TPU-first middle tier the reference answers with a PS
+(embedding_delegate.py RPC lookups). Parity asserted against plain
+jnp.take in forward, backward, and a full DeepFM train step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from elasticdl_tpu.parallel.sharded_embedding import (
+    ShardedEmbed,
+    padded_vocab,
+    shard_table_rows,
+    sharded_embedding_lookup,
+)
+
+N = 8
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:N]), ("data",))
+
+
+def test_lookup_matches_take():
+    mesh = _mesh()
+    rng = np.random.default_rng(0)
+    vocab, dim = 64, 5
+    table = rng.normal(size=(vocab, dim)).astype(np.float32)
+    # Edge ids included: 0, vocab-1, repeats, and every shard's block.
+    ids = np.concatenate(
+        [rng.integers(0, vocab, size=(N * 2 - 2, 7)),
+         [[0] * 7, [vocab - 1] * 7]]
+    ).astype(np.int32)
+    dev_table = shard_table_rows(table, mesh)
+    out = jax.jit(
+        lambda t, i: sharded_embedding_lookup(t, i, mesh)
+    )(dev_table, ids)
+    np.testing.assert_allclose(
+        np.asarray(out), np.take(table, ids, axis=0), rtol=1e-6
+    )
+
+
+def test_lookup_gradients_match_take():
+    """The backward pass routes each row-gradient to the owning shard —
+    identical totals to autodiff through a plain take."""
+    mesh = _mesh()
+    rng = np.random.default_rng(1)
+    vocab, dim = 40, 3  # 40 % 8 == 0
+    table = rng.normal(size=(vocab, dim)).astype(np.float32)
+    ids = rng.integers(0, vocab, size=(16, 4)).astype(np.int32)
+    w = rng.normal(size=(16, 4, dim)).astype(np.float32)
+
+    def loss_sharded(t):
+        return jnp.sum(sharded_embedding_lookup(t, ids, mesh) * w)
+
+    def loss_take(t):
+        return jnp.sum(jnp.take(t, ids, axis=0) * w)
+
+    dev_table = shard_table_rows(table, mesh)
+    g_sharded = jax.jit(jax.grad(loss_sharded))(dev_table)
+    g_take = jax.grad(loss_take)(jnp.asarray(table))
+    np.testing.assert_allclose(
+        np.asarray(g_sharded), np.asarray(g_take), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_sharded_embed_module():
+    mesh = _mesh()
+    emb = ShardedEmbed(num_embeddings=50, features=4, mesh=mesh)
+    ids = jnp.asarray(
+        np.random.default_rng(2).integers(0, 50, size=(8, 3)), jnp.int32
+    )
+    params = emb.init(jax.random.PRNGKey(0), ids)["params"]
+    # Vocab padded up to the axis size; pad rows never addressed.
+    assert params["embedding"].shape == (padded_vocab(50, N), 4)
+    out = emb.apply({"params": params}, ids)
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.take(np.asarray(params["embedding"]), np.asarray(ids), axis=0),
+        rtol=1e-6,
+    )
+
+
+def test_deepfm_sharded_train_step_matches_replicated():
+    """The VERDICT-r2 'done' bar: DeepFM trains with device-sharded
+    tables on the 8-device mesh and matches the replicated-table model's
+    loss and gradients on the same batch and params."""
+    from elasticdl_tpu.models.dac_ctr import deepfm
+
+    mesh = _mesh()
+    vocab = 160  # divisible by 8: shared param shapes across placements
+    model_rep = deepfm.DeepFMCriteo(vocab=vocab)
+    model_sh = deepfm.custom_sharded_model(mesh, vocab=vocab)
+
+    rng = np.random.default_rng(3)
+    batch = 32
+    features = {
+        "dense": rng.normal(size=(batch, 13)).astype(np.float32),
+        "ids": rng.integers(0, vocab, size=(batch, 39)).astype(np.int32),
+    }
+    labels = rng.integers(0, 2, batch).astype(np.int64)
+    params = model_rep.init(
+        jax.random.PRNGKey(0), features, training=False
+    )["params"]
+
+    def grads_of(model):
+        def loss_of(p):
+            return deepfm.loss(
+                labels, model.apply({"params": p}, features, training=True)
+            )
+
+        return jax.value_and_grad(loss_of)
+
+    loss_rep, g_rep = jax.jit(grads_of(model_rep))(params)
+
+    specs = deepfm.sharded_param_specs(params)
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda v: isinstance(v, P),
+    )
+    batch_sh = NamedSharding(mesh, P("data"))
+    with mesh:
+        loss_sh, g_sh = jax.jit(
+            grads_of(model_sh),
+            in_shardings=(shardings,),
+            out_shardings=(NamedSharding(mesh, P()), shardings),
+        )(jax.device_put(params, shardings))
+    np.testing.assert_allclose(float(loss_sh), float(loss_rep), rtol=1e-5)
+    for (path, got), (_, want) in zip(
+        jax.tree_util.tree_leaves_with_path(g_sh),
+        jax.tree_util.tree_leaves_with_path(g_rep),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-6,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_deepfm_sharded_converges_on_mesh():
+    """Full Adam training loop with sharded tables + batch sharding over
+    the same axis: loss decreases (the composed DP x sharded-table step
+    the AllReduce strategy would run)."""
+    from elasticdl_tpu.models.dac_ctr import deepfm
+
+    mesh = _mesh()
+    vocab = 160
+    model = deepfm.custom_sharded_model(mesh, vocab=vocab)
+    rng = np.random.default_rng(4)
+    batch = 64
+    features = {
+        "dense": rng.normal(size=(batch, 13)).astype(np.float32),
+        "ids": rng.integers(0, vocab, size=(batch, 39)).astype(np.int32),
+    }
+    # Learnable signal: label correlates with one dense feature.
+    labels = (features["dense"][:, 0] > 0).astype(np.int64)
+    params = model.init(jax.random.PRNGKey(0), features, training=False)[
+        "params"
+    ]
+    opt = optax.adam(1e-2)
+    specs = deepfm.sharded_param_specs(params)
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda v: isinstance(v, P),
+    )
+    batch_sh = NamedSharding(mesh, P("data"))
+
+    def step(p, s, f, l):
+        def loss_of(p):
+            return deepfm.loss(
+                l, model.apply({"params": p}, f, training=True)
+            )
+
+        loss, g = jax.value_and_grad(loss_of)(p)
+        updates, s = opt.update(g, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    jitted = jax.jit(
+        step,
+        in_shardings=(shardings, None, batch_sh, batch_sh),
+        out_shardings=(shardings, None, NamedSharding(mesh, P())),
+    )
+    with mesh:
+        p = jax.device_put(params, shardings)
+        s = opt.init(params)
+        f = jax.device_put(features, batch_sh)
+        l = jax.device_put(labels, batch_sh)
+        losses = []
+        for _ in range(30):
+            p, s, loss = jitted(p, s, f, l)
+            losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
