@@ -1,123 +1,182 @@
-"""Thin Kubernetes client for pod create/watch/delete.
+"""Kubernetes client for pod create/watch/delete.
 
 Reference counterpart: /root/reference/elasticdl/python/common/
 k8s_client.py:40-300 and the client-package base
-(elasticdl_client/common/k8s_client.py:50-242). Import-gated: the
-`kubernetes` package is an optional dependency — everything cluster-facing
-lives behind this module so the rest of the framework imports cleanly
-without it.
+(elasticdl_client/common/k8s_client.py:50-242). Two transports behind one
+surface:
+
+- the official `kubernetes` package when importable (real clusters with a
+  kubeconfig), or
+- the stdlib REST transport (common/k8s_rest.py) against the in-cluster
+  service account or EDL_K8S_API_SERVER — no optional dependency needed,
+  and the wire path is testable against a local stub API server
+  (tests/fake_k8s_server.py).
+
+Pod/service bodies are plain manifest dicts (both transports accept them
+verbatim), so what the tests assert is exactly what a cluster receives.
 """
 
+import threading
+
+from elasticdl_tpu.common import k8s_rest
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("common.k8s_client")
 
 try:  # pragma: no cover - exercised only on a real cluster
-    from kubernetes import client as k8s_api
+    from kubernetes import client as k8s_api  # noqa: F401
     from kubernetes import config as k8s_config
     from kubernetes import watch as k8s_watch
 
-    K8S_AVAILABLE = True
+    K8S_PACKAGE_AVAILABLE = True
 except ImportError:  # pragma: no cover
     k8s_api = k8s_config = k8s_watch = None
-    K8S_AVAILABLE = False
+    K8S_PACKAGE_AVAILABLE = False
+
+# Backwards-compatible alias (pre-round-3 code gated on the package only).
+K8S_AVAILABLE = K8S_PACKAGE_AVAILABLE
 
 ELASTICDL_JOB_KEY = "elasticdl-job-name"
 ELASTICDL_REPLICA_TYPE_KEY = "elasticdl-replica-type"
 ELASTICDL_REPLICA_INDEX_KEY = "elasticdl-replica-index"
 
 
-def build_volumes(volume_dicts):
-    """Parsed volume dicts (common/k8s_resource.parse_volume_spec) ->
-    (V1Volume list, V1VolumeMount list). The grouping/dedup logic lives
-    in k8s_resource.group_volume_manifests (shared with the master-pod
-    manifest builder); this only converts dict manifests to V1 objects."""
-    if not volume_dicts:
-        return [], []
-    require_k8s()
-    from elasticdl_tpu.common.k8s_resource import group_volume_manifests
-
-    vol_manifests, mount_manifests = group_volume_manifests(volume_dicts)
-    volumes = []
-    for v in vol_manifests:
-        if "persistentVolumeClaim" in v:
-            pvc = v["persistentVolumeClaim"]
-            volumes.append(
-                k8s_api.V1Volume(
-                    name=v["name"],
-                    persistent_volume_claim=(
-                        k8s_api.V1PersistentVolumeClaimVolumeSource(
-                            claim_name=pvc["claimName"],
-                            read_only=pvc["readOnly"],
-                        )
-                    ),
-                )
-            )
-        else:
-            volumes.append(
-                k8s_api.V1Volume(
-                    name=v["name"],
-                    host_path=k8s_api.V1HostPathVolumeSource(
-                        path=v["hostPath"]["path"]
-                    ),
-                )
-            )
-    mounts = [
-        k8s_api.V1VolumeMount(
-            name=m["name"],
-            mount_path=m["mountPath"],
-            sub_path=m.get("subPath"),
-        )
-        for m in mount_manifests
-    ]
-    return volumes, mounts
+def k8s_reachable():
+    return K8S_PACKAGE_AVAILABLE or k8s_rest.default_rest_api() is not None
 
 
 def require_k8s():
-    if not K8S_AVAILABLE:
+    if not k8s_reachable():
         raise RuntimeError(
-            "the 'kubernetes' python package is not installed; "
-            "K8s-backed instance management is unavailable "
-            "(use the local-process backend or install kubernetes)"
+            "no Kubernetes access: the 'kubernetes' package is not "
+            "installed and neither EDL_K8S_API_SERVER nor an in-cluster "
+            "service account is present (use the local-process backend, "
+            "install kubernetes, or point EDL_K8S_API_SERVER at an API "
+            "server)"
         )
 
 
-class Client:  # pragma: no cover - exercised only on a real cluster
+def build_pod_manifest(
+    name,
+    labels,
+    image,
+    command,
+    resource_requests=None,
+    resource_limits=None,
+    priority_class=None,
+    envs=None,
+    volumes=None,
+    restart_policy="Never",
+):
+    """One replica pod as a manifest dict (shared by both transports and
+    asserted verbatim by the stub-server tests)."""
+    from elasticdl_tpu.common.k8s_resource import group_volume_manifests
+
+    env = [
+        {"name": k, "value": v} for k, v in (envs or {}).items()
+    ]
+    # Every replica learns its own routable address (workers advertise it
+    # as their comm host; the master binds on it).
+    env.append(
+        {
+            "name": "MY_POD_IP",
+            "valueFrom": {"fieldRef": {"fieldPath": "status.podIP"}},
+        }
+    )
+    vol_manifests, mount_manifests = group_volume_manifests(volumes or [])
+    container = {
+        "name": "main",
+        "image": image,
+        "command": list(command),
+        "env": env,
+        "resources": {
+            **(
+                {"requests": resource_requests}
+                if resource_requests
+                else {}
+            ),
+            **({"limits": resource_limits} if resource_limits else {}),
+        },
+        **({"volumeMounts": mount_manifests} if mount_manifests else {}),
+    }
+    spec = {
+        "containers": [container],
+        "restartPolicy": restart_policy,
+        **({"volumes": vol_manifests} if vol_manifests else {}),
+        **(
+            {"priorityClassName": priority_class} if priority_class else {}
+        ),
+    }
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "labels": labels},
+        "spec": spec,
+    }
+
+
+class Client:
     """Pod lifecycle for one job's master/worker/PS replicas."""
 
-    def __init__(self, namespace, job_name, image_name, event_callback=None):
-        require_k8s()
-        try:
-            k8s_config.load_incluster_config()
-        except Exception:
-            k8s_config.load_kube_config()
+    def __init__(self, namespace, job_name, image_name, event_callback=None,
+                 rest_api=None):
         self.namespace = namespace
         self.job_name = job_name
         self.image_name = image_name
-        self._v1 = k8s_api.CoreV1Api()
         self._event_cb = event_callback
+        self._stop_watch = threading.Event()
+        self._rest = None
+        self._v1 = None
+        if rest_api is not None:
+            self._rest = rest_api
+        elif K8S_PACKAGE_AVAILABLE:  # pragma: no cover - real cluster
+            try:
+                k8s_config.load_incluster_config()
+            except Exception:
+                k8s_config.load_kube_config()
+            self._v1 = k8s_api.CoreV1Api()
+        else:
+            self._rest = k8s_rest.default_rest_api()
+            if self._rest is None:
+                require_k8s()
         if event_callback:
-            import threading
-
             threading.Thread(target=self._watch, daemon=True).start()
 
+    def stop(self):
+        self._stop_watch.set()
+
+    # ---------- watch ----------
+
     def _watch(self):
-        w = k8s_watch.Watch()
-        while True:
+        selector = f"{ELASTICDL_JOB_KEY}={self.job_name}"
+        if self._rest is not None:
+            self._rest.watch_pods(
+                self.namespace,
+                selector,
+                self._event_cb,
+                stop_event=self._stop_watch,
+            )
+            return
+        w = k8s_watch.Watch()  # pragma: no cover - real cluster
+        while not self._stop_watch.is_set():
             try:
                 for event in w.stream(
                     self._v1.list_namespaced_pod,
                     self.namespace,
-                    label_selector=f"{ELASTICDL_JOB_KEY}={self.job_name}",
+                    label_selector=selector,
                 ):
                     self._event_cb(event)
             except Exception:
                 logger.warning("k8s watch stream reset", exc_info=True)
 
-    def pod_name(self, replica_type, replica_index):
-        return (
-            f"elasticdl-{self.job_name}-{replica_type}-{replica_index}"
-        )
+    # ---------- pods / services ----------
+
+    def pod_name(self, replica_type, replica_index, incarnation=0):
+        """Relaunches get a fresh name (-r<N> suffix): a Failed pod still
+        occupies its name on the API server, so re-creating under the same
+        name is a guaranteed 409 AlreadyExists."""
+        base = f"elasticdl-{self.job_name}-{replica_type}-{replica_index}"
+        return base if not incarnation else f"{base}-r{incarnation}"
 
     def create_pod(
         self,
@@ -130,83 +189,111 @@ class Client:  # pragma: no cover - exercised only on a real cluster
         envs=None,
         volumes=None,
         restart_policy="Never",
+        incarnation=0,
     ):
-        env = [
-            k8s_api.V1EnvVar(name=k, value=v)
-            for k, v in (envs or {}).items()
-        ]
-        # Every replica learns its own routable address (workers advertise
-        # it as their comm host; the master binds on it).
-        env.append(
-            k8s_api.V1EnvVar(
-                name="MY_POD_IP",
-                value_from=k8s_api.V1EnvVarSource(
-                    field_ref=k8s_api.V1ObjectFieldSelector(
-                        field_path="status.podIP"
-                    )
-                ),
-            )
+        manifest = build_pod_manifest(
+            self.pod_name(replica_type, replica_index, incarnation),
+            {
+                ELASTICDL_JOB_KEY: self.job_name,
+                ELASTICDL_REPLICA_TYPE_KEY: replica_type,
+                ELASTICDL_REPLICA_INDEX_KEY: str(replica_index),
+            },
+            self.image_name,
+            command,
+            resource_requests=resource_requests,
+            resource_limits=resource_limits,
+            priority_class=priority_class,
+            envs=envs,
+            volumes=volumes,
+            restart_policy=restart_policy,
         )
-        pod_volumes, mounts = build_volumes(volumes or [])
-        container = k8s_api.V1Container(
-            name="main",
-            image=self.image_name,
-            command=command,
-            resources=k8s_api.V1ResourceRequirements(
-                requests=resource_requests, limits=resource_limits
-            ),
-            env=env,
-            volume_mounts=mounts or None,
-        )
-        pod = k8s_api.V1Pod(
-            metadata=k8s_api.V1ObjectMeta(
-                name=self.pod_name(replica_type, replica_index),
-                labels={
-                    ELASTICDL_JOB_KEY: self.job_name,
-                    ELASTICDL_REPLICA_TYPE_KEY: replica_type,
-                    ELASTICDL_REPLICA_INDEX_KEY: str(replica_index),
-                },
-            ),
-            spec=k8s_api.V1PodSpec(
-                containers=[container],
-                restart_policy=restart_policy,
-                priority_class_name=priority_class,
-                volumes=pod_volumes or None,
-            ),
-        )
-        return self._v1.create_namespaced_pod(self.namespace, pod)
+        return self.create_pod_from_manifest(manifest)
 
     def create_pod_from_manifest(self, manifest):
-        """Create a pod from a raw manifest dict (used for the master pod so
-        serviceAccountName/env fieldRefs survive verbatim)."""
+        """Create a pod from a raw manifest dict (the master pod keeps its
+        serviceAccountName/env fieldRefs verbatim)."""
+        if self._rest is not None:
+            return self._rest.create_pod(self.namespace, manifest)
         return self._v1.create_namespaced_pod(self.namespace, manifest)
 
     def create_service(self, name, port, replica_type, replica_index):
         """Stable DNS name for a replica (PS pods get one each, reference
         common/k8s_client.py service creation)."""
-        service = k8s_api.V1Service(
-            metadata=k8s_api.V1ObjectMeta(
-                name=name,
-                labels={ELASTICDL_JOB_KEY: self.job_name},
-            ),
-            spec=k8s_api.V1ServiceSpec(
-                selector={
+        manifest = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": name,
+                "labels": {ELASTICDL_JOB_KEY: self.job_name},
+            },
+            "spec": {
+                "selector": {
                     ELASTICDL_JOB_KEY: self.job_name,
                     ELASTICDL_REPLICA_TYPE_KEY: replica_type,
                     ELASTICDL_REPLICA_INDEX_KEY: str(replica_index),
                 },
-                ports=[k8s_api.V1ServicePort(port=port)],
-            ),
-        )
-        return self._v1.create_namespaced_service(self.namespace, service)
+                "ports": [{"port": port}],
+            },
+        }
+        if self._rest is not None:
+            return self._rest.create_service(self.namespace, manifest)
+        return self._v1.create_namespaced_service(self.namespace, manifest)
 
-    def delete_pod(self, replica_type, replica_index):
-        self._v1.delete_namespaced_pod(
-            self.pod_name(replica_type, replica_index), self.namespace
-        )
+    def create_tensorboard_service(self, port=6006):
+        """LoadBalancer service exposing the master pod's TensorBoard
+        (reference common/k8s_tensorboard_client.py:22-66): in-cluster
+        jobs get an external URL for `edl tensorboard`'s server."""
+        manifest = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": f"tensorboard-{self.job_name}",
+                "labels": {ELASTICDL_JOB_KEY: self.job_name},
+            },
+            "spec": {
+                "type": "LoadBalancer",
+                "selector": {
+                    ELASTICDL_JOB_KEY: self.job_name,
+                    ELASTICDL_REPLICA_TYPE_KEY: "master",
+                },
+                "ports": [{"port": port, "targetPort": port}],
+            },
+        }
+        if self._rest is not None:
+            return self._rest.create_service(self.namespace, manifest)
+        return self._v1.create_namespaced_service(self.namespace, manifest)
 
-    def get_pod_phase(self, replica_type, replica_index):
-        pod = self._v1.read_namespaced_pod(
-            self.pod_name(replica_type, replica_index), self.namespace
+    def get_tensorboard_external_ip(self):
+        """External address of the TensorBoard LoadBalancer once the cloud
+        provider assigns one (None until then)."""
+        name = f"tensorboard-{self.job_name}"
+        if self._rest is not None:
+            svc = self._rest.read_service(self.namespace, name)
+            ingress = (
+                ((svc.get("status") or {}).get("loadBalancer") or {}).get(
+                    "ingress"
+                )
+                or []
+            )
+            return ingress[0].get("ip") if ingress else None
+        svc = self._v1.read_namespaced_service(name, self.namespace)
+        ingress = (
+            svc.status.load_balancer.ingress
+            if svc.status and svc.status.load_balancer
+            else None
         )
+        return ingress[0].ip if ingress else None
+
+    def delete_pod(self, replica_type, replica_index, incarnation=0):
+        name = self.pod_name(replica_type, replica_index, incarnation)
+        if self._rest is not None:
+            return self._rest.delete_pod(self.namespace, name)
+        return self._v1.delete_namespaced_pod(name, self.namespace)
+
+    def get_pod_phase(self, replica_type, replica_index, incarnation=0):
+        name = self.pod_name(replica_type, replica_index, incarnation)
+        if self._rest is not None:
+            pod = self._rest.read_pod(self.namespace, name)
+            return ((pod.get("status") or {}).get("phase"))
+        pod = self._v1.read_namespaced_pod(name, self.namespace)
         return pod.status.phase

@@ -66,6 +66,11 @@ class K8sInstanceManager:
         self._stopping = False
         self._statuses = {}  # (kind, id) -> PodStatus
         self._relaunches = {}  # (kind, id) -> count
+        # (kind, id) -> current pod incarnation: a relaunch creates a NEW
+        # pod name (-rN), so events from the dead predecessor (its
+        # eventual DELETED, late MODIFIEDs) can be told apart from the
+        # replacement's and ignored instead of cascading more relaunches.
+        self._incarnations = {}
         self._client = k8s_client.Client(
             namespace, job_name, image_name, event_callback=self._event_cb
         )
@@ -91,6 +96,8 @@ class K8sInstanceManager:
         device_limits = {
             k: v for k, v in (resources or {}).items() if "/" in k
         }
+        with self._lock:
+            incarnation = self._incarnations.get((kind, instance_id), 0)
         self._client.create_pod(
             kind,
             instance_id,
@@ -104,6 +111,7 @@ class K8sInstanceManager:
             ),
             envs=self._envs,
             volumes=self._volumes,
+            incarnation=incarnation,
         )
         if kind == "ps":
             # Stable service name so a relaunched PS keeps its address and
@@ -130,12 +138,21 @@ class K8sInstanceManager:
     def stop(self):
         with self._lock:
             self._stopping = True
-            keys = list(self._statuses)
-        for kind, instance_id in keys:
-            try:
-                self._client.delete_pod(kind, instance_id)
-            except Exception:
-                pass
+            keys = {
+                (kind, instance_id): self._incarnations.get(
+                    (kind, instance_id), 0
+                )
+                for (kind, instance_id) in self._statuses
+            }
+        self._client.stop()
+        for (kind, instance_id), incarnation in keys.items():
+            # Current incarnation plus any failed predecessors still
+            # occupying their names.
+            for inc in range(incarnation + 1):
+                try:
+                    self._client.delete_pod(kind, instance_id, inc)
+                except Exception:
+                    pass
 
     # ---------- watch-event state machine ----------
 
@@ -153,6 +170,17 @@ class K8sInstanceManager:
         instance_id = int(
             labels.get(k8s_client.ELASTICDL_REPLICA_INDEX_KEY, -1)
         )
+        with self._lock:
+            incarnation = self._incarnations.get((kind, instance_id), 0)
+        expected_name = self._client.pod_name(
+            kind, instance_id, incarnation
+        )
+        pod_name = pod.metadata.name
+        if pod_name is not None and pod_name != expected_name:
+            # A dead predecessor's late event (e.g. its DELETED after we
+            # already relaunched under a new name): not this replica's
+            # current pod, so it must not drive the state machine.
+            return
         phase = pod.status.phase
         deleted = event["type"] == "DELETED"
         with self._lock:
@@ -196,9 +224,25 @@ class K8sInstanceManager:
             can_relaunch = relaunch and count < self._max_relaunches
             if can_relaunch:
                 self._relaunches[(kind, instance_id)] = count + 1
+                # New incarnation = new pod name; the failed pod keeps
+                # its name on the API server (re-creating it would 409).
+                self._incarnations[(kind, instance_id)] = (
+                    self._incarnations.get((kind, instance_id), 0) + 1
+                )
+                old_incarnation = (
+                    self._incarnations[(kind, instance_id)] - 1
+                )
             else:
                 self._statuses[(kind, instance_id)] = PodStatus.FAILED
         if can_relaunch:
+            # Reap the failed predecessor (best-effort; it may already be
+            # gone when the trigger was a deletion).
+            try:
+                self._client.delete_pod(
+                    kind, instance_id, old_incarnation
+                )
+            except Exception:
+                pass
             # PS keeps its id and service address so workers re-seed it
             # transparently (reference k8s_instance_manager.py:399-404).
             self._start(kind, instance_id)

@@ -321,6 +321,26 @@ class Master:
             if self.args.num_ps:
                 self.instance_manager.start_parameter_servers()
             self.instance_manager.start_workers()
+        if (
+            self.metrics_service is not None
+            and self.args.instance_backend == "k8s"
+        ):
+            # In-cluster TensorBoard exposure (reference
+            # k8s_tensorboard_client.py:22-66): a LoadBalancer service
+            # pointing at this master pod; `edl tensorboard
+            # --logdir <metrics_dir>` serves behind it.
+            try:
+                client = getattr(self.instance_manager, "_client", None)
+                if client is not None:
+                    client.create_tensorboard_service()
+                    logger.info(
+                        "Created TensorBoard LoadBalancer service "
+                        "tensorboard-%s", self.args.job_name,
+                    )
+            except Exception:
+                logger.warning(
+                    "TensorBoard service creation failed", exc_info=True
+                )
 
     def run(self, poll_seconds=None):
         """Poll until done/failed (reference master.py:238-263). Returns the

@@ -1,0 +1,241 @@
+"""A local fake Kubernetes API server (stdlib http.server).
+
+Implements just enough of the core/v1 REST surface for the framework's
+pod lifecycle — create/read/delete pods, create/read services, and the
+chunked label-selector watch stream — so the live submission path
+(client/main._submit_k8s -> Client.create_pod_from_manifest) and the
+K8sInstanceManager's watch/relaunch loop execute end to end over real
+HTTP with no cluster. The reference only ever exercised these against
+minikube in CI (scripts/travis/run_job.sh:33-39); this is the
+"stub API server" analog.
+
+Pods don't run containers: tests drive phase transitions explicitly via
+`set_pod_phase`, which also fans the MODIFIED event out to watchers.
+"""
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlsplit
+
+
+class FakeK8sApiServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pods = {}  # (ns, name) -> manifest dict (with status)
+        self._services = {}  # (ns, name) -> manifest
+        self._watchers = []  # (ns, selector dict, queue)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _json(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _read_body(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            def do_POST(self):
+                parts = urlsplit(self.path).path.strip("/").split("/")
+                # api/v1/namespaces/{ns}/{pods|services}
+                if len(parts) == 5 and parts[:3] == [
+                    "api", "v1", "namespaces",
+                ]:
+                    ns, kind = parts[3], parts[4]
+                    manifest = self._read_body()
+                    name = manifest["metadata"]["name"]
+                    if kind == "pods":
+                        created = outer._create_pod(ns, name, manifest)
+                        if created is None:
+                            return self._json(
+                                409,
+                                {"reason": "AlreadyExists",
+                                 "message": name},
+                            )
+                        return self._json(201, created)
+                    if kind == "services":
+                        with outer._lock:
+                            if (ns, name) in outer._services:
+                                return self._json(
+                                    409, {"reason": "AlreadyExists"}
+                                )
+                            outer._services[(ns, name)] = manifest
+                        return self._json(201, manifest)
+                self._json(404, {"reason": "NotFound"})
+
+            def do_GET(self):
+                url = urlsplit(self.path)
+                parts = url.path.strip("/").split("/")
+                qs = parse_qs(url.query)
+                if len(parts) == 5 and parts[4] == "pods" and qs.get(
+                    "watch"
+                ):
+                    return self._watch(parts[3], qs)
+                if len(parts) == 6 and parts[4] == "pods":
+                    with outer._lock:
+                        pod = outer._pods.get((parts[3], parts[5]))
+                    if pod is None:
+                        return self._json(404, {"reason": "NotFound"})
+                    return self._json(200, pod)
+                if len(parts) == 6 and parts[4] == "services":
+                    with outer._lock:
+                        svc = outer._services.get((parts[3], parts[5]))
+                    if svc is None:
+                        return self._json(404, {"reason": "NotFound"})
+                    return self._json(200, svc)
+                if len(parts) == 5 and parts[4] == "pods":
+                    selector = outer._parse_selector(qs)
+                    with outer._lock:
+                        items = [
+                            p
+                            for (ns, _), p in outer._pods.items()
+                            if ns == parts[3]
+                            and outer._matches(p, selector)
+                        ]
+                    return self._json(
+                        200, {"kind": "PodList", "items": items}
+                    )
+                self._json(404, {"reason": "NotFound"})
+
+            def do_DELETE(self):
+                parts = urlsplit(self.path).path.strip("/").split("/")
+                if len(parts) == 6 and parts[4] == "pods":
+                    ns, name = parts[3], parts[5]
+                    with outer._lock:
+                        pod = outer._pods.pop((ns, name), None)
+                    if pod is None:
+                        return self._json(404, {"reason": "NotFound"})
+                    outer._emit(ns, "DELETED", pod)
+                    return self._json(200, pod)
+                self._json(404, {"reason": "NotFound"})
+
+            def _watch(self, ns, qs):
+                selector = outer._parse_selector(qs)
+                q = queue.Queue()
+                with outer._lock:
+                    # Current state first (the official watch behaves the
+                    # same when resourceVersion is omitted).
+                    for (pns, _), p in outer._pods.items():
+                        if pns == ns and outer._matches(p, selector):
+                            q.put({"type": "ADDED", "object": p})
+                    outer._watchers.append((ns, selector, q))
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    while True:
+                        event = q.get()
+                        if event is None:
+                            break
+                        line = (json.dumps(event) + "\n").encode()
+                        self.wfile.write(
+                            b"%x\r\n%s\r\n" % (len(line), line)
+                        )
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                finally:
+                    with outer._lock:
+                        outer._watchers = [
+                            w for w in outer._watchers if w[2] is not q
+                        ]
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    # ---------- server lifecycle ----------
+
+    @property
+    def endpoint(self):
+        host, port = self._httpd.server_address
+        return f"http://{host}:{port}"
+
+    def stop(self):
+        with self._lock:
+            watchers = list(self._watchers)
+        for _, _, q in watchers:
+            q.put(None)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # ---------- state helpers (tests drive pod phases) ----------
+
+    @staticmethod
+    def _parse_selector(qs):
+        raw = unquote((qs.get("labelSelector") or [""])[0])
+        selector = {}
+        for part in raw.split(","):
+            if "=" in part:
+                k, v = part.split("=", 1)
+                selector[k] = v
+        return selector
+
+    @staticmethod
+    def _matches(pod, selector):
+        labels = (pod.get("metadata") or {}).get("labels") or {}
+        return all(labels.get(k) == v for k, v in selector.items())
+
+    def _create_pod(self, ns, name, manifest):
+        with self._lock:
+            if (ns, name) in self._pods:
+                return None
+            manifest = dict(manifest)
+            manifest.setdefault("status", {"phase": "Pending"})
+            self._pods[(ns, name)] = manifest
+        self._emit(ns, "ADDED", manifest)
+        return manifest
+
+    def _emit(self, ns, event_type, pod):
+        with self._lock:
+            watchers = list(self._watchers)
+        for wns, selector, q in watchers:
+            if wns == ns and self._matches(pod, selector):
+                q.put({"type": event_type, "object": pod})
+
+    def pods(self, ns="default"):
+        with self._lock:
+            return {
+                name: dict(p)
+                for (pns, name), p in self._pods.items()
+                if pns == ns
+            }
+
+    def services(self, ns="default"):
+        with self._lock:
+            return {
+                name: dict(s)
+                for (pns, name), s in self._services.items()
+                if pns == ns
+            }
+
+    def set_pod_phase(self, ns, name, phase, container_statuses=None):
+        """Drive a pod's lifecycle (what kubelet would do) and notify
+        watchers."""
+        with self._lock:
+            pod = self._pods.get((ns, name))
+            if pod is None:
+                raise KeyError(name)
+            pod["status"] = {
+                "phase": phase,
+                **(
+                    {"containerStatuses": container_statuses}
+                    if container_statuses
+                    else {}
+                ),
+            }
+        self._emit(ns, "MODIFIED", pod)

@@ -104,18 +104,25 @@ class FakeK8sClient:
         self.deleted = []
         FakeK8sClient.instances.append(self)
 
+    def pod_name(self, replica_type, replica_index, incarnation=0):
+        base = f"elasticdl-{self.job_name}-{replica_type}-{replica_index}"
+        return base if not incarnation else f"{base}-r{incarnation}"
+
     def create_pod(self, replica_type, replica_index, command, **kwargs):
         self.created.append((replica_type, replica_index, kwargs))
 
     def create_service(self, name, port, replica_type, replica_index):
         self.services.append((name, port, replica_type, replica_index))
 
-    def delete_pod(self, replica_type, replica_index):
-        self.deleted.append((replica_type, replica_index))
+    def delete_pod(self, replica_type, replica_index, incarnation=0):
+        self.deleted.append((replica_type, replica_index, incarnation))
+
+    def stop(self):
+        pass
 
 
 def _pod_event(kind, index, phase, event_type="MODIFIED", exit_code=None,
-               reason=None):
+               reason=None, incarnation=0):
     statuses = []
     if exit_code is not None:
         statuses = [
@@ -127,12 +134,16 @@ def _pod_event(kind, index, phase, event_type="MODIFIED", exit_code=None,
                 )
             )
         ]
+    name = f"elasticdl-job-{kind}-{index}"
+    if incarnation:
+        name += f"-r{incarnation}"
     pod = SimpleNamespace(
         metadata=SimpleNamespace(
+            name=name,
             labels={
                 k8s_client.ELASTICDL_REPLICA_TYPE_KEY: kind,
                 k8s_client.ELASTICDL_REPLICA_INDEX_KEY: str(index),
-            }
+            },
         ),
         status=SimpleNamespace(
             phase=phase, container_statuses=statuses
@@ -202,9 +213,21 @@ def test_deleted_worker_recovers_tasks_and_relaunches(manager):
         (k, i) for k, i, _ in client.created if (k, i) == ("worker", 0)
     ]
     assert len(relaunches) == 2
-    # A second deletion exceeds max_relaunches=1: worker 0 stays FAILED.
+    # The replacement runs under a NEW pod name (-r1): a late event from
+    # the dead predecessor's name must be ignored, not re-relaunched.
     client.event_cb(
         _pod_event("worker", 0, "Failed", event_type="DELETED")
+    )
+    assert (
+        len([(k, i) for k, i, _ in client.created if (k, i) == ("worker", 0)])
+        == 2
+    )
+    # A second deletion OF THE REPLACEMENT exceeds max_relaunches=1:
+    # worker 0 stays FAILED.
+    client.event_cb(
+        _pod_event(
+            "worker", 0, "Failed", event_type="DELETED", incarnation=1
+        )
     )
     assert (
         len(
