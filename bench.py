@@ -152,22 +152,22 @@ def bench_deepfm_criteo(batch_size=32768, steps=30, warmup=5):
     }
 
 
-def bench_deepfm_ps(batch_size=32768, steps=12, warmup=3, num_ps=2):
+def bench_deepfm_ps(batch_size=8192, steps=8, warmup=2, num_ps=2):
     """The other half of the DeepFM north star (BASELINE.json: "large
     embedding_service + elastic worker preemption"): DeepFM with its
-    embedding tables PS-RESIDENT on 2 real localhost PS shards (native
+    wide/deep tables PS-RESIDENT on 2 real localhost PS shards (native
     C++ kernels), one TPU worker pulling rows / pushing IndexedSlices
-    per step. Measured both ways: the pipelined async path (push on a
-    background thread, pulls overlapping the previous step's device
-    compute) vs the fully serialized loop — the before/after of the
-    round-3 overlap work."""
+    per step (models/dac_ctr/deepfm_ps). Measured both ways: the
+    pipelined async path (push on a background thread, pulls overlapping
+    the previous step's device compute) vs the fully serialized loop —
+    the before/after of the round-3 overlap work."""
     from elasticdl_tpu.common.model_utils import get_model_spec
     from elasticdl_tpu.models.dac_ctr.transform import NUM_FIELDS, TOTAL_IDS
     from elasticdl_tpu.ps.parameter_server import ParameterServer
     from elasticdl_tpu.worker.ps_client import PSClient
     from elasticdl_tpu.worker.ps_trainer import ParameterServerTrainer
 
-    spec = get_model_spec("elasticdl_tpu.models.dac_ctr.deepfm")
+    spec = get_model_spec("elasticdl_tpu.models.dac_ctr.deepfm_ps")
     rng = np.random.default_rng(0)
     n_batches = 4  # distinct id sets so pulls stay realistic
     batches = []
@@ -200,6 +200,7 @@ def bench_deepfm_ps(batch_size=32768, steps=12, warmup=3, num_ps=2):
                 spec.loss,
                 spec.build_optimizer_spec(),
                 client,
+                embedding_inputs=spec.module.embedding_inputs,
                 pipeline_pushes=pipelined,
             )
             for i in range(warmup):

@@ -1,0 +1,76 @@
+"""Criteo DeepFM with PS-RESIDENT embedding tables — the BASELINE.json
+north-star deployment shape ("large embedding_service + elastic worker
+preemption").
+
+Reference counterpart: /root/reference/model_zoo/dac_ctr/deepfm_model.py
+served through the EDL embedding layer (model_zoo/deepfm_edl_embedding/
+deepfm_edl_embedding.py:19-58). Same architecture and feature transform as
+models/dac_ctr/deepfm (the device-resident variant benchmarks dense
+compute; this one exercises the sparse pull/push path): the wide [V,1] and
+deep [V,D] tables live in the parameter server, only looked-up rows ever
+reach the chip. The dense side (DNN + linear) stays an ordinary param tree
+pulled/pushed per step — a few KB next to the tables' ~180 MB.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from elasticdl_tpu.layers.embedding import DistributedEmbedding
+from elasticdl_tpu.models.dac_ctr.common import (
+    DNN,
+    ctr_loss,
+    ctr_metrics,
+    fm_interaction,
+)
+from elasticdl_tpu.models.dac_ctr.transform import feed  # noqa: F401
+from elasticdl_tpu.ops import optimizers
+
+
+class DeepFMCriteoPS(nn.Module):
+    deep_dim: int = 8
+    dnn_hidden_units: tuple = (16, 4)
+
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        ids = features["ids"].astype(jnp.int32)  # [B, F]
+        dense = features["dense"].astype(jnp.float32)  # [B, 13]
+        linear = DistributedEmbedding(table_name="wide", dim=1)(ids)[
+            ..., 0
+        ]  # [B, F]
+        field_embs = DistributedEmbedding(
+            table_name="deep", dim=self.deep_dim
+        )(ids)  # [B, F, D]
+        dense_logit = nn.Dense(1, use_bias=False, name="dense_linear")(
+            dense
+        )
+        linear_logits = jnp.concatenate([linear, dense_logit], axis=1)
+        fm = fm_interaction(field_embs)
+        dnn_input = jnp.concatenate(
+            [dense, field_embs.reshape(field_embs.shape[0], -1)], axis=1
+        )
+        dnn_logit = nn.Dense(1, use_bias=False)(
+            DNN(self.dnn_hidden_units)(dnn_input)
+        )
+        return (
+            jnp.sum(linear_logits, axis=1) + fm + dnn_logit.reshape(-1)
+        )
+
+
+def custom_model():
+    return DeepFMCriteoPS()
+
+
+def embedding_inputs(features):
+    """Both PS tables key off the shared offset id space."""
+    return {"wide": features["ids"], "deep": features["ids"]}
+
+
+loss = ctr_loss
+
+
+def optimizer(lr=0.001):
+    return optimizers.adam(learning_rate=lr)
+
+
+def eval_metrics_fn():
+    return ctr_metrics()

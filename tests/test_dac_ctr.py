@@ -108,3 +108,50 @@ def test_deepctr_wdl_trains():
     for metric in spec.build_metrics().values():
         metric.update(outputs, labels)
         assert np.isfinite(metric.result())
+
+
+def test_deepfm_ps_variant_trains_against_real_ps():
+    """The PS-resident Criteo DeepFM (models/dac_ctr/deepfm_ps): wide and
+    deep tables live in 2 real localhost PS shards, only looked-up rows
+    reach the device; loss drops and the PS tables materialize rows."""
+    from elasticdl_tpu.ps.parameter_server import ParameterServer
+    from elasticdl_tpu.worker.ps_client import PSClient
+    from elasticdl_tpu.worker.ps_trainer import ParameterServerTrainer
+
+    spec = get_model_spec("elasticdl_tpu.models.dac_ctr.deepfm_ps")
+    servers = [
+        ParameterServer(
+            i, 2, optimizer_spec=spec.build_optimizer_spec()
+        )
+        for i in range(2)
+    ]
+    client = None
+    trainer = None
+    try:
+        client = PSClient([s.addr for s in servers], worker_id=0)
+        trainer = ParameterServerTrainer(
+            spec.build_model(),
+            spec.loss,
+            spec.build_optimizer_spec(),
+            client,
+            embedding_inputs=spec.module.embedding_inputs,
+        )
+        records = list(iter_criteo_records(256, seed=13))
+        features, labels = spec.feed(records, Modes.TRAINING, None)
+        losses = []
+        for _ in range(25):
+            _, _, loss = trainer.train_minibatch(features, labels)
+            losses.append(float(loss))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], (losses[0], losses[-1])
+        # The tables live PS-side, not in the worker's param tree.
+        assert "wide" not in trainer._variables["params"]
+        ids, values = client.pull_embedding_table("deep", dim=8)
+        assert ids.size > 0 and values.shape[1] == 8
+    finally:
+        if trainer is not None:
+            trainer.close()
+        if client is not None:
+            client.close()
+        for s in servers:
+            s.stop()
