@@ -87,6 +87,22 @@ class CTREmbeddings(nn.Module):
         return linear_logits, field_embs, dense
 
 
+def deepfm_head(linear_logits, field_embs, dense, dnn_hidden_units=(16, 4)):
+    """The DeepFM output assembly shared by the device-resident and
+    PS-resident variants: first-order logits + FM second-order term +
+    DNN over [dense, flattened field embeddings]. Call inside the
+    owning module's @nn.compact so the Dense/DNN params keep their
+    scope names."""
+    fm = fm_interaction(field_embs)
+    dnn_input = jnp.concatenate(
+        [dense, field_embs.reshape(field_embs.shape[0], -1)], axis=1
+    )
+    dnn_logit = nn.Dense(1, use_bias=False)(
+        DNN(dnn_hidden_units)(dnn_input)
+    )
+    return jnp.sum(linear_logits, axis=1) + fm + dnn_logit.reshape(-1)
+
+
 def fm_interaction(field_embs):
     """Second-order FM term via the (sum^2 - sum of squares)/2 identity:
     [B, F, D] -> [B]."""

@@ -6,15 +6,13 @@ second-order term uses the (sum^2 - sum-of-squares)/2 identity — one fused
 elementwise expression under XLA.
 """
 
-import jax.numpy as jnp
 import flax.linen as nn
 
 from elasticdl_tpu.models.dac_ctr.common import (
     CTREmbeddings,
-    DNN,
     ctr_loss,
     ctr_metrics,
-    fm_interaction,
+    deepfm_head,
 )
 from elasticdl_tpu.models.dac_ctr.transform import feed  # noqa: F401
 from elasticdl_tpu.ops import optimizers
@@ -37,15 +35,8 @@ class DeepFMCriteo(nn.Module):
             shard_mesh=self.shard_mesh,
             shard_axis=self.shard_axis,
         )(features)
-        fm = fm_interaction(field_embs)  # [B]
-        dnn_input = jnp.concatenate(
-            [dense, field_embs.reshape(field_embs.shape[0], -1)], axis=1
-        )
-        dnn_logit = nn.Dense(1, use_bias=False)(
-            DNN(self.dnn_hidden_units)(dnn_input)
-        )
-        return (
-            jnp.sum(linear_logits, axis=1) + fm + dnn_logit.reshape(-1)
+        return deepfm_head(
+            linear_logits, field_embs, dense, self.dnn_hidden_units
         )
 
 

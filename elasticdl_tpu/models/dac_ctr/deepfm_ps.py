@@ -17,10 +17,9 @@ import jax.numpy as jnp
 
 from elasticdl_tpu.layers.embedding import DistributedEmbedding
 from elasticdl_tpu.models.dac_ctr.common import (
-    DNN,
     ctr_loss,
     ctr_metrics,
-    fm_interaction,
+    deepfm_head,
 )
 from elasticdl_tpu.models.dac_ctr.transform import feed  # noqa: F401
 from elasticdl_tpu.ops import optimizers
@@ -44,15 +43,8 @@ class DeepFMCriteoPS(nn.Module):
             dense
         )
         linear_logits = jnp.concatenate([linear, dense_logit], axis=1)
-        fm = fm_interaction(field_embs)
-        dnn_input = jnp.concatenate(
-            [dense, field_embs.reshape(field_embs.shape[0], -1)], axis=1
-        )
-        dnn_logit = nn.Dense(1, use_bias=False)(
-            DNN(self.dnn_hidden_units)(dnn_input)
-        )
-        return (
-            jnp.sum(linear_logits, axis=1) + fm + dnn_logit.reshape(-1)
+        return deepfm_head(
+            linear_logits, field_embs, dense, self.dnn_hidden_units
         )
 
 

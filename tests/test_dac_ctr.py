@@ -144,8 +144,15 @@ def test_deepfm_ps_variant_trains_against_real_ps():
             losses.append(float(loss))
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0], (losses[0], losses[-1])
-        # The tables live PS-side, not in the worker's param tree.
-        assert "wide" not in trainer._variables["params"]
+        # The tables live PS-side, not in the worker's param tree: no
+        # DistributedEmbedding subtree may have materialized a local
+        # fallback table.
+        from elasticdl_tpu.common.pytree_utils import flatten_params
+
+        named, _ = flatten_params(trainer._variables["params"])
+        assert not any("DistributedEmbedding" in k for k in named), (
+            sorted(named)
+        )
         ids, values = client.pull_embedding_table("deep", dim=8)
         assert ids.size > 0 and values.shape[1] == 8
     finally:

@@ -49,22 +49,30 @@ def main(batch=4, seq_len=4096, steps=30, profile_dir="", out_name=None):
         batch * 4, seq_len, vocab=cfg.vocab, branching=4, seed=0
     )
     losses = []
+    profiling = False
+    trace_start = min(10, max(1, steps - 2))
     t_first = time.perf_counter()
     for i in range(steps):
         sl = slice((i % 4) * batch, (i % 4 + 1) * batch)
         feats = tokens[sl, :-1]
         labels = tokens[sl, 1:]
-        if profile_dir and i == 10:
+        if profile_dir and i == trace_start:
             jax.profiler.start_trace(profile_dir)
+            profiling = True
         _, _, loss = trainer.train_minibatch(feats, labels)
-        if profile_dir and i == 13:
+        if profiling and i >= trace_start + 3:
             float(loss)
             jax.profiler.stop_trace()
+            profiling = False
         losses.append(loss)
         if i == 0:
             compile_s = time.perf_counter() - t_first
             float(loss)
             t_steady = time.perf_counter()
+    if profiling:
+        # Short runs end inside the window; an unclosed trace is empty.
+        float(losses[-1])
+        jax.profiler.stop_trace()
     losses = [float(l) for l in losses]  # forces completion of every step
     steady_s = time.perf_counter() - t_steady
     n_params = sum(
@@ -73,8 +81,13 @@ def main(batch=4, seq_len=4096, steps=30, profile_dir="", out_name=None):
     )
     tokens_per_sec = batch * seq_len * (steps - 1) / steady_s
     mfu, flops_per_token = _flagship_mfu(cfg, n_params, tokens_per_sec)
+    if profile_dir and out_name is None:
+        # Tracing start/stop + its sync sit inside the timing window:
+        # don't clobber the canonical (untraced) numbers by default.
+        out_name = "FLAGSHIP_PROFILE.json"
     result = {
         "device": jax.devices()[0].device_kind,
+        **({"profiled": True} if profile_dir else {}),
         "params": n_params,
         "batch": batch,
         "seq_len": seq_len,
