@@ -277,3 +277,35 @@ def test_ps_strategy_two_ps_auto_embedding_cli(tmp_path):
         if "item_emb" in params.embedding_tables:
             table_ids += len(params.embedding_tables["item_emb"])
     assert table_ids > 0, "item_emb never reached the PS embedding store"
+
+
+def test_multihost_lease_mode_with_evaluation(tmp_path, linear_data):
+    """Lease-mode training interleaved with version-triggered evaluation
+    (TRAINING_WITH_EVALUATION under --multi_host): leases drain the
+    training work, eval tasks drain through the WAIT branch and the
+    post-lease task loop, and the job completes with an export."""
+    output = str(tmp_path / "model.npz")
+    res = run_edl(
+        "train",
+        "--model_zoo", f"{REPO}/tests",
+        "--model_def", "test_module",
+        "--training_data", linear_data,
+        "--validation_data", linear_data,
+        "--evaluation_steps", "6",
+        "--num_epochs", "10",
+        "--records_per_task", "32",
+        "--minibatch_size", "32",
+        "--num_workers", "1",
+        "--distribution_strategy", "AllreduceStrategy",
+        "--multi_host",
+        "--instance_backend", "local_process",
+        "--master_port", "0",
+        "--coordinator_port", "53400",
+        "--output", output,
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert "Minted lease" in res.stderr
+    assert "evaluation" in res.stderr.lower()
+    with np.load(output) as data:
+        kernel = data["params/Dense_0/kernel"].reshape(-1)
+    np.testing.assert_allclose(kernel, test_module.TRUE_W, atol=0.1)
