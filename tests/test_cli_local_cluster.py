@@ -309,3 +309,37 @@ def test_multihost_lease_mode_with_evaluation(tmp_path, linear_data):
     with np.load(output) as data:
         kernel = data["params/Dense_0/kernel"].reshape(-1)
     np.testing.assert_allclose(kernel, test_module.TRUE_W, atol=0.1)
+
+
+def test_multihost_two_workers_with_evaluation(tmp_path, linear_data):
+    """TWO worker processes in one SPMD world with validation data: the
+    multi-host evaluate_minibatch path (host-copy + process-local
+    forward — a global-mesh forward would need every process) runs on
+    whichever worker draws the eval tasks, while training stays
+    lease-synchronized. Completes with a converged export."""
+    output = str(tmp_path / "model.npz")
+    res = run_edl(
+        "train",
+        "--model_zoo", f"{REPO}/tests",
+        "--model_def", "test_module",
+        "--training_data", linear_data,
+        "--validation_data", linear_data,
+        "--evaluation_steps", "8",
+        "--num_epochs", "16",
+        "--records_per_task", "32",
+        "--minibatch_size", "16",
+        "--num_workers", "2",
+        "--distribution_strategy", "AllreduceStrategy",
+        "--multi_host",
+        "--instance_backend", "local_process",
+        "--master_port", "0",
+        "--coordinator_port", "53500",
+        "--output", output,
+        timeout=420,
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert "Minted lease" in res.stderr
+    assert "world 2" in res.stderr  # both processes in one lease world
+    with np.load(output) as data:
+        kernel = data["params/Dense_0/kernel"].reshape(-1)
+    np.testing.assert_allclose(kernel, test_module.TRUE_W, atol=0.1)
