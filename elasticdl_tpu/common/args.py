@@ -150,6 +150,15 @@ def add_cluster_arguments(parser):
         "step-synchronized task leases",
     )
     parser.add_argument(
+        "--zero1",
+        action="store_true",
+        default=False,
+        help="shard optimizer state over the data axis (cross-replica "
+        "weight-update sharding): per-chip optimizer memory drops by "
+        "the DP degree, update compiles as reduce-scatter -> "
+        "shard-local math -> all-gather",
+    )
+    parser.add_argument(
         "--coordinator_port",
         type=int,
         default=51000,
@@ -274,6 +283,7 @@ def worker_parser():
         ],
     )
     p.add_argument("--multi_host", action="store_true", default=False)
+    p.add_argument("--zero1", action="store_true", default=False)
     return p
 
 

@@ -42,6 +42,7 @@ _WORKER_RELAY_ARGS = [
     "seed",
     "model_parallel_size",
     "multi_host",
+    "zero1",
     "training_data",
     "validation_data",
     "prediction_data",

@@ -78,10 +78,16 @@ class AllReduceTrainer(JaxTrainer):
         seed=0,
         model_parallel_size=1,
         param_specs_fn=None,
+        zero1=False,
     ):
         super().__init__(model, loss_fn, optimizer_spec, seed=seed)
         self._model_parallel_size = max(1, int(model_parallel_size or 1))
         self._param_specs_fn = param_specs_fn
+        # Cross-replica weight-update sharding (ZeRO-1, parallel/zero1.py):
+        # optimizer state shards over the data axis, GSPMD compiles the
+        # update as reduce-scatter -> shard-local math -> all-gather.
+        # Pure-DP meshes only (under TP the opt layout follows the params).
+        self._zero1 = bool(zero1)
         if multi_host and self._model_parallel_size > 1:
             # Multi-host TP would shard params across processes, making
             # them non-fully-addressable — the host-side state snapshot
@@ -226,12 +232,13 @@ class AllReduceTrainer(JaxTrainer):
                 host_state = pulled
         if host_state is not None:
             variables, opt_state, version = host_state
-            repl = replicated_sharding(self._mesh)
             with self._state_lock:
                 self._variables = jax.device_put(
                     variables, self._variables_sharding(variables)
                 )
-                self._opt_state = jax.device_put(opt_state, repl)
+                self._opt_state = jax.device_put(
+                    opt_state, self._opt_placement(opt_state)
+                )
                 self._version = version
         elif self._variables is not None:
             # Local device state was unreadable (poisoned by a failed
@@ -404,6 +411,19 @@ class AllReduceTrainer(JaxTrainer):
         )
         return bad
 
+    def _opt_placement(self, opt_tree):
+        """Optimizer-state layout on the current mesh: ZeRO-1 dim-0
+        sharding over the data axis when enabled (pure DP), replicated
+        otherwise (under TP the initial replication is resharded by GSPMD
+        to mirror the param layout after the first step)."""
+        if self._zero1 and not self._tp_active():
+            from elasticdl_tpu.parallel.zero1 import (
+                weight_update_shardings,
+            )
+
+            return weight_update_shardings(opt_tree, self._mesh)
+        return replicated_sharding(self._mesh)
+
     def _tp_active(self):
         return (
             self._param_specs_fn is not None
@@ -473,9 +493,15 @@ class AllReduceTrainer(JaxTrainer):
             # unconstrained (None): GSPMD propagation reshards mu/nu to
             # mirror the param layout after the first step (one extra
             # compile when the inferred layout differs from the initial
-            # replicated placement).
+            # replicated placement). Under ZeRO-1 the state pins to its
+            # data-axis dim-0 sharding so the update compiles as
+            # reduce-scatter -> shard-local math -> all-gather.
             var_sh = self._variables_sharding(self._variables)
-            opt_sh = None if self._tp_active() else repl
+            opt_sh = (
+                None
+                if self._tp_active()
+                else self._opt_placement(self._opt_state)
+            )
             step = jax.jit(
                 step_fn,
                 in_shardings=(var_sh, opt_sh, repl, data, data),
@@ -492,11 +518,12 @@ class AllReduceTrainer(JaxTrainer):
         if self._mesh is None:
             self.init_world_if_needed(force=True)
         elif first_init:
-            repl = replicated_sharding(self._mesh)
             self._variables = jax.device_put(
                 self._variables, self._variables_sharding(self._variables)
             )
-            self._opt_state = jax.device_put(self._opt_state, repl)
+            self._opt_state = jax.device_put(
+                self._opt_state, self._opt_placement(self._opt_state)
+            )
 
     def train_minibatch(self, features, labels):
         self.init_variables_if_needed(features)

@@ -57,6 +57,7 @@ def build_trainer(args, spec, master_client):
             seed=args.seed,
             model_parallel_size=args.model_parallel_size,
             param_specs_fn=getattr(spec.module, "param_specs", None),
+            zero1=args.zero1,
         )
     from elasticdl_tpu.worker.trainer import LocalTrainer
 
