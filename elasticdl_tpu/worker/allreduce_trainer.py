@@ -88,6 +88,26 @@ class AllReduceTrainer(JaxTrainer):
         # update as reduce-scatter -> shard-local math -> all-gather.
         # Pure-DP meshes only (under TP the opt layout follows the params).
         self._zero1 = bool(zero1)
+        if zero1 and multi_host:
+            # Same failure mode the multi-host TP guard below rejects:
+            # dim-0 sharding over a cross-process data axis makes the
+            # optimizer state non-fully-addressable, so the host snapshot
+            # backing elastic regroups (_state_provider) cannot
+            # device_get it — every world change would silently broadcast
+            # zeros over all training state.
+            raise ValueError(
+                "zero1=True is not supported with multi_host=True: "
+                "optimizer state sharded across processes breaks the "
+                "regroup state snapshot. Use ZeRO-1 within one host "
+                "(single process, multiple chips) or pure DP across "
+                "hosts."
+            )
+        if zero1 and self._model_parallel_size > 1:
+            logger.warning(
+                "zero1 is ignored when tensor parallelism is active "
+                "(the optimizer layout follows the param layout); "
+                "per-chip optimizer memory will NOT drop"
+            )
         if multi_host and self._model_parallel_size > 1:
             # Multi-host TP would shard params across processes, making
             # them non-fully-addressable — the host-side state snapshot

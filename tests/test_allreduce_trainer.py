@@ -381,3 +381,22 @@ def test_zero1_weight_update_sharding_matches_replicated():
             assert losses[-1] < losses[0], losses
         finally:
             t.close()
+
+
+def test_zero1_multi_host_rejected():
+    """zero1 + multi_host would make the optimizer state
+    non-fully-addressable and break the regroup snapshot (same guard
+    shape as multi-host TP)."""
+    with start_master(
+        training_shards={"f": (0, 100)}, with_membership=True
+    ) as m:
+        mc = MasterClient(m["addr"], worker_id=0, worker_host="127.0.0.1")
+        with pytest.raises(ValueError, match="zero1"):
+            AllReduceTrainer(
+                test_module.custom_model(),
+                test_module.loss,
+                test_module.optimizer(),
+                mc,
+                multi_host=True,
+                zero1=True,
+            )
