@@ -1,0 +1,120 @@
+"""Quantized cross-replica gradient reduction (parallel/quantized.py,
+EQuARX-style int8 wire payloads): numeric error bounded by the per-block
+quantization step, and a DP training loop using it still converges to
+the same solution as exact reduction."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from elasticdl_tpu.parallel.quantized import (
+    quantized_pmean,
+    quantized_psum_1d,
+)
+
+N = 8
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:N]), ("data",))
+
+
+def test_quantized_psum_matches_exact_within_step():
+    mesh = _mesh()
+    rng = np.random.default_rng(0)
+    # Per-replica distinct vectors (sharded over the axis).
+    x = rng.normal(size=(N, 64 * N)).astype(np.float32)
+
+    def exact(v):
+        return jax.lax.psum(v, "data")
+
+    def quant(v):
+        return quantized_psum_1d(v, "data")
+
+    run = lambda f: shard_map(  # noqa: E731
+        f, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+        check_vma=False,
+    )
+    want = np.asarray(run(lambda v: exact(v[0])[None])(x))
+    got = np.asarray(run(lambda v: quant(v[0])[None])(x))
+    # Two quantized wire legs: error <= 2 * (blockwise absmax of the
+    # involved tensors) / 127 per element; bound it loosely but
+    # meaningfully relative to the summed magnitudes.
+    step = 2 * np.abs(x).max() * N / 127.0
+    np.testing.assert_allclose(got, want, atol=step)
+    assert not np.array_equal(got, want)  # it IS quantized
+
+
+def test_quantized_pmean_tree_roundtrip():
+    mesh = _mesh()
+    rng = np.random.default_rng(1)
+    tree = {
+        "w": rng.normal(size=(N, 8, 3)).astype(np.float32),
+        "b": rng.normal(size=(N, 5)).astype(np.float32),
+    }
+
+    def body(t):
+        local = jax.tree_util.tree_map(lambda a: a[0], t)
+        out = quantized_pmean(local, "data")
+        return jax.tree_util.tree_map(lambda a: a[None], out)
+
+    got = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P("data"), tree),),
+        out_specs=jax.tree_util.tree_map(lambda _: P("data"), tree),
+        check_vma=False,
+    )(tree)
+    for key in tree:
+        want = tree[key].mean(axis=0)
+        for r in range(N):
+            np.testing.assert_allclose(
+                np.asarray(got[key])[r], want, atol=0.05
+            )
+
+
+def test_dp_training_with_quantized_gradients_converges():
+    """Explicit-gradient DP step: per-shard grads, quantized-allreduce
+    mean, shared SGD update — converges to the same linear solution as
+    exact reduction (quantization noise behaves like stochastic
+    rounding, not bias)."""
+    mesh = _mesh()
+    rng = np.random.default_rng(2)
+    true_w = np.asarray([1.0, -2.0, 3.0, 0.5], np.float32)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = (x @ true_w).astype(np.float32)
+
+    def grads_of(w, xb, yb):
+        def loss(w):
+            return jnp.mean((xb @ w - yb) ** 2)
+
+        return jax.grad(loss)(w)
+
+    def make_step(reduce_fn):
+        def step(w, xb, yb):
+            g = grads_of(w, xb, yb)
+            g = reduce_fn(g, "data")
+            return w - 0.05 * g
+
+        return shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(P(), P("data"), P("data")),
+            out_specs=P(),
+            check_vma=False,
+        )
+
+    quant_step = jax.jit(make_step(quantized_pmean))
+    exact_step = jax.jit(
+        make_step(lambda g, ax: jax.lax.pmean(g, ax))
+    )
+    wq = jnp.zeros(4)
+    we = jnp.zeros(4)
+    for _ in range(200):
+        wq = quant_step(wq, x, y)
+        we = exact_step(we, x, y)
+    np.testing.assert_allclose(np.asarray(we), true_w, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(wq), true_w, atol=0.02)
