@@ -248,3 +248,53 @@ def test_shared_table_double_application_refused():
         model.init(
             {"params": jax.random.PRNGKey(0)}, feats, training=False
         )
+
+
+def test_device_capacity_tier_through_trainer():
+    """Hybrid placement through the TRAINER: with a device capacity above
+    every table, nothing swaps (the PS holds dense params only and the
+    model trains with its stock embeds); with a capacity between the two
+    tables, only the big one goes to the PS."""
+    spec = get_model_spec("auto_embedding_test_module")
+    records = auto_mod.make_records(128)
+    feats, labels = auto_mod.feed(records[:32], "training", None)
+
+    # Capacity above both tables: fully device-resident model.
+    servers, addrs = start_pservers(2, spec)
+    try:
+        trainer = ParameterServerTrainer(
+            spec.build_model(),
+            spec.loss,
+            spec.build_optimizer_spec(),
+            PSClient(addrs),
+            embedding_threshold_bytes=64,
+            embedding_device_capacity_bytes=1024,
+        )
+        trainer.init_variables_if_needed(feats)
+        assert trainer._embedding_dims == {}
+        params = trainer._variables["params"]
+        assert "item_emb" in params  # stock embed kept
+        ok, _, _ = trainer.train_minibatch(feats, labels)
+        assert ok
+    finally:
+        for s in servers:
+            s.stop()
+
+    # Capacity between the tables: only item_emb (320 B) is PS-resident.
+    servers, addrs = start_pservers(2, spec)
+    try:
+        trainer = ParameterServerTrainer(
+            spec.build_model(),
+            spec.loss,
+            spec.build_optimizer_spec(),
+            PSClient(addrs),
+            embedding_threshold_bytes=64,
+            embedding_device_capacity_bytes=128,
+        )
+        trainer.init_variables_if_needed(feats)
+        assert set(trainer._embedding_dims) == {"item_emb"}
+        ok, _, _ = trainer.train_minibatch(feats, labels)
+        assert ok
+    finally:
+        for s in servers:
+            s.stop()
