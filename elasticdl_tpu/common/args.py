@@ -35,6 +35,14 @@ def add_common_arguments(parser):
         ],
     )
     parser.add_argument("--minibatch_size", type=int, default=64)
+    parser.add_argument(
+        "--get_model_steps",
+        type=int,
+        default=1,
+        help="PS strategy: pull fresh params every N minibatches, train "
+        "with the locally-updated model in between (gradients still "
+        "push every step)",
+    )
     parser.add_argument("--log_loss_steps", type=int, default=100)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(

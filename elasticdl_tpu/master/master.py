@@ -38,6 +38,7 @@ _WORKER_RELAY_ARGS = [
     "model_def",
     "distribution_strategy",
     "minibatch_size",
+    "get_model_steps",
     "log_loss_steps",
     "seed",
     "model_parallel_size",

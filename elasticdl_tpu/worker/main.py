@@ -44,6 +44,7 @@ def build_trainer(args, spec, master_client):
                 spec.module, "embedding_device_capacity_bytes", 0
             ),
             seed=args.seed,
+            model_steps=args.get_model_steps,
         )
     if strategy == DistributionStrategy.ALLREDUCE:
         from elasticdl_tpu.worker.allreduce_trainer import AllReduceTrainer

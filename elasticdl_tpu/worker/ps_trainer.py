@@ -51,6 +51,7 @@ class ParameterServerTrainer(JaxTrainer):
         max_push_retries=DEFAULT_MAX_PUSH_RETRIES,
         seed=0,
         pipeline_pushes=None,
+        model_steps=1,
     ):
         super().__init__(model, loss_fn, optimizer_spec, seed=seed)
         self._ps = ps_client
@@ -68,6 +69,14 @@ class ParameterServerTrainer(JaxTrainer):
         self._pipeline_pushes = pipeline_pushes and use_async
         self._push_executor = None
         self._push_future = None
+        # get_model_steps (reference worker.py:314-327): pull fresh PS
+        # params only every N training minibatches; in between, train
+        # with the LOCAL model — gradients apply locally through the
+        # worker's own optimizer while still being pushed every step.
+        # Cuts the pull RPC (and its host decode) to 1/N.
+        self._model_steps = max(1, int(model_steps or 1))
+        self._since_pull = self._model_steps  # force a pull first
+        self._local_step = None  # jitted local apply, built lazily
         # callable(features) -> {table_name: ids ndarray}. Optional: when
         # omitted, the ModelHandler auto-swaps oversized nn.Embed tables
         # to the PS and derives the feed by id capture (init below).
@@ -189,9 +198,40 @@ class ParameterServerTrainer(JaxTrainer):
 
     # ---------- PS sync ----------
 
+    def _maybe_sync_model(self):
+        """Pull from the PS only when the local model is stale
+        (get_model_steps-style local training): fresh pull resets the
+        counter; between pulls the local optimizer keeps the dense params
+        moving."""
+        if self._since_pull >= self._model_steps:
+            self._sync_model()
+            return True
+        self._since_pull += 1
+        return False
+
+    def _apply_local(self, param_grads):
+        """Advance the LOCAL dense params with this step's grads (the
+        reference's _update_local_model) so the next minibatch's forward
+        doesn't need a pull. The PS still owns the truth — the next pull
+        overwrites any local drift."""
+        if self._local_step is None:
+            def apply(params, opt_state, grads):
+                updates, opt_state = self._optax.update(
+                    grads, opt_state, params
+                )
+                import optax as _optax
+
+                return _optax.apply_updates(params, updates), opt_state
+
+            self._local_step = jax.jit(apply)
+        self._variables["params"], self._opt_state = self._local_step(
+            self._variables["params"], self._opt_state, param_grads
+        )
+
     def _sync_model(self):
         """Pull dense params; re-seed any uninitialized shard from local
         weights (that IS the PS fault-tolerance path)."""
+        self._since_pull = 1
         # The PSClient tracks per-shard pull cursors: a shard only re-sends
         # params newer than this client's last pull from it.
         initialized, version, named = self._ps.pull_dense_parameters(
@@ -305,7 +345,13 @@ class ParameterServerTrainer(JaxTrainer):
         device_labels = _to_device_batch(labels)
         for attempt in range(self._max_push_retries):
             with self.timing.record("pull_model"):
-                self._sync_model()
+                if attempt == 0:
+                    self._maybe_sync_model()
+                else:
+                    # A stale rejection means the local model diverged
+                    # from the PS: the retry must re-pull regardless of
+                    # the local-training cadence.
+                    self._sync_model()
             with self.timing.record("prefetch_embeddings"):
                 emb_rows, flat_ids = self._prefetch_embeddings(features)
             self._rng, step_rng = jax.random.split(self._rng)
@@ -322,6 +368,8 @@ class ParameterServerTrainer(JaxTrainer):
                     device_labels,
                 )
             self._variables.update(new_state)
+            if self._model_steps > 1:
+                self._apply_local(param_grads)
             accepted, _ = self._push_payload(
                 param_grads,
                 emb_grads,
@@ -353,7 +401,7 @@ class ParameterServerTrainer(JaxTrainer):
         device_labels = _to_device_batch(labels)
         # These RPCs overlap the PREVIOUS step's device compute.
         with self.timing.record("pull_model"):
-            self._sync_model()
+            self._maybe_sync_model()
         with self.timing.record("prefetch_embeddings"):
             emb_rows, flat_ids = self._prefetch_embeddings(features)
         self._rng, step_rng = jax.random.split(self._rng)
@@ -370,6 +418,8 @@ class ParameterServerTrainer(JaxTrainer):
                 device_labels,
             )
         self._variables.update(new_state)
+        if self._model_steps > 1:
+            self._apply_local(param_grads)
         # One push in flight: wait out the previous (raising its errors),
         # then hand this step's grads to the push thread. Its device_get
         # blocks there until the step's compute finishes.

@@ -202,3 +202,51 @@ def test_ps_pipelined_pushes_converge_and_flush():
     finally:
         for s in servers:
             s.stop()
+
+
+def test_get_model_steps_local_training():
+    """get_model_steps=4 (reference worker.py:314-327): the worker pulls
+    PS params every 4th minibatch and trains with the locally-updated
+    model in between; gradients still push every step; convergence
+    holds and the pull count is ~steps/4."""
+    spec = get_model_spec("test_module")
+    servers, addrs = start_pservers(2, spec)
+    try:
+        client = PSClient(addrs, worker_id=0)
+        pulls = {"n": 0}
+        real_pull = client.pull_dense_parameters
+
+        def counted(*a, **kw):
+            pulls["n"] += 1
+            return real_pull(*a, **kw)
+
+        client.pull_dense_parameters = counted
+        trainer = ParameterServerTrainer(
+            spec.build_model(),
+            spec.loss,
+            spec.build_optimizer_spec(),
+            client,
+            model_steps=4,
+            pipeline_pushes=False,
+        )
+        rng = np.random.default_rng(0)
+        records = test_module.make_linear_records(256)
+        losses = []
+        steps = 40
+        for _ in range(steps):
+            idx = rng.integers(0, len(records), size=16)
+            f, l = spec.feed([records[i] for i in idx], "training", None)
+            ok, version, loss = trainer.train_minibatch(f, l)
+            assert ok
+            losses.append(float(loss))
+        # Pulls: 1 init-path + ceil(40/4); bound loosely but well below
+        # one per step.
+        assert pulls["n"] <= steps // 4 + 3, pulls["n"]
+        assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+        # The PS still advanced a version per push (every step pushed).
+        assert trainer.get_model_version() >= steps - 2
+        trainer.close()
+        client.close()
+    finally:
+        for s in servers:
+            s.stop()

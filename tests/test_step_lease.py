@@ -159,3 +159,77 @@ def test_lease_owner_ids_are_disjoint_from_workers():
     assert is_lease_owner(lease_owner_id(500))
     assert not is_lease_owner(0)
     assert not is_lease_owner(-1)  # "no worker" sentinel is not a lease
+
+
+def test_lease_chaos_random_membership_never_loses_records():
+    """Property drill: random interleavings of joins, departures, lease
+    completions and failure reports must never lose training records or
+    deadlock — every record trains (task-level completion accounting),
+    retries stay bounded, and the run terminates with the dispatcher
+    finished."""
+    import random
+
+    rng = random.Random(1234)
+    for trial in range(8):
+        records = 512
+        task_d = TaskDispatcher(
+            {"s": (0, records)},
+            records_per_task=rng.choice([32, 64, 96]),
+            num_epochs=1,
+            shuffle=bool(trial % 2),
+        )
+        membership = MembershipManager()
+        leases = StepLeaseManager(
+            task_d, membership, target_steps=rng.choice([2, 4])
+        )
+        workers = {}  # wid -> host
+        next_wid = 0
+
+        def join():
+            nonlocal next_wid
+            wid = next_wid
+            next_wid += 1
+            host = f"h{wid}:1"
+            membership.register(wid, host)
+            workers[wid] = host
+
+        def leave():
+            if len(workers) > 1:
+                wid = rng.choice(sorted(workers))
+                membership.remove_worker(wid)
+                del workers[wid]
+
+        join()
+        join()
+        guard = 0
+        while not task_d.finished():
+            guard += 1
+            assert guard < 2000, "lease chaos did not terminate"
+            event = rng.random()
+            if event < 0.08:
+                join()
+                continue
+            if event < 0.14:
+                leave()
+                continue
+            # Every live worker polls; completing ranks report.
+            responses = {}
+            for wid, host in sorted(workers.items()):
+                r = leases.lease_steps(wid, host, batch_size=16)
+                if r.status == OK:
+                    responses[wid] = r
+            if not responses:
+                continue
+            if rng.random() < 0.1:
+                # One rank reports a transient failure: lease aborts
+                # through the retry ladder.
+                wid, r = rng.choice(sorted(responses.items()))
+                leases.report_lease(
+                    r.lease_id, r.rank, False, "chaos"
+                )
+                continue
+            for wid, r in sorted(responses.items()):
+                leases.report_lease(r.lease_id, r.rank, True)
+        assert not task_d.job_failed, f"trial {trial} failed the job"
+        assert task_d.stats()["records_done"] >= records, trial
+        assert task_d.stats()["doing"] == 0, trial
