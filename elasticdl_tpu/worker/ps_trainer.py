@@ -14,7 +14,10 @@ ps_trainer.py:36-441. Behaviors kept:
   (ps_trainer.py:372-386).
 
 Worker-side params are a cache of PS state (async SGD): the PS owns the
-model version; the worker never applies updates locally.
+model version. With get_model_steps > 1 the worker additionally advances
+its CACHED params through its own optimizer between pulls (the
+reference's train_with_local_model) — the next successful pull overwrites
+that local drift, so the PS remains the source of truth.
 """
 
 import jax
@@ -231,7 +234,6 @@ class ParameterServerTrainer(JaxTrainer):
     def _sync_model(self):
         """Pull dense params; re-seed any uninitialized shard from local
         weights (that IS the PS fault-tolerance path)."""
-        self._since_pull = 1
         # The PSClient tracks per-shard pull cursors: a shard only re-sends
         # params newer than this client's last pull from it.
         initialized, version, named = self._ps.pull_dense_parameters(
@@ -251,6 +253,10 @@ class ParameterServerTrainer(JaxTrainer):
                 {k: jnp.asarray(v) for k, v in named.items()},
             )
         self._version = max(self._version, version)
+        # Reset the local-training cadence only on a SUCCESSFUL pull: a
+        # transient PS failure must not suppress re-pull attempts for the
+        # next model_steps-1 minibatches.
+        self._since_pull = 1
 
     def _prefetch_embeddings(self, features):
         """features -> (rows {table: [n_positions, dim]}, flat_ids
@@ -368,8 +374,6 @@ class ParameterServerTrainer(JaxTrainer):
                     device_labels,
                 )
             self._variables.update(new_state)
-            if self._model_steps > 1:
-                self._apply_local(param_grads)
             accepted, _ = self._push_payload(
                 param_grads,
                 emb_grads,
@@ -378,6 +382,11 @@ class ParameterServerTrainer(JaxTrainer):
                 int(np.asarray(labels).shape[0]),
             )
             if accepted:
+                # Local apply only for ACCEPTED steps: a stale-rejected
+                # attempt re-pulls anyway, and folding its grads into the
+                # local Adam moments once per retry would bias them.
+                if self._model_steps > 1:
+                    self._apply_local(param_grads)
                 return True, self._version, float(loss)
             logger.info(
                 "Gradient push rejected as stale (attempt %d); re-pulling",
