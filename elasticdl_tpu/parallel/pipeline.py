@@ -300,6 +300,44 @@ def make_lm_pipeline(cfg, mesh, n_stages, num_microbatches,
 # ---------- 1F1B schedule ----------
 
 
+def vocab_parallel_head_loss(cfg, head_ln, v_loc, axis_name, head_params,
+                             y, labels_m, shard):
+    """Vocab-parallel CE for one microbatch, shared by the 1F1B and
+    interleaved-1F1B schedules: each shard computes its [v_loc] logit
+    slice; pmax/psum over `axis_name` assemble the full log-sum-exp and
+    label logit. Returns the mean CE over this shard's tokens.
+
+    Gradient conventions the CALLER must match: under shard_map with
+    check_vma=False the internal psums TRANSPOSE TO PSUM, so each
+    device's vjp cotangents (d_head, dy) come out axis-size x their true
+    share — combine with psum(...)/n. The max is stop_gradient'd BEFORE
+    the pmax (pmax has no differentiation rule; the max only stabilizes
+    the exp)."""
+    z = head_ln.apply(
+        {"params": head_params["LayerNorm_0"]}, y
+    ).astype(jnp.float32)
+    kernel = head_params["lm_head"]["kernel"].astype(jnp.float32)
+    bias = head_params["lm_head"]["bias"].astype(jnp.float32)
+    k_loc = jax.lax.dynamic_slice_in_dim(
+        kernel, shard * v_loc, v_loc, axis=1
+    )
+    b_loc = jax.lax.dynamic_slice_in_dim(bias, shard * v_loc, v_loc, 0)
+    logits = z @ k_loc + b_loc  # [mb, S, v_loc]
+    m_loc = jax.lax.stop_gradient(jnp.max(logits, axis=-1))
+    m_glob = jax.lax.pmax(m_loc, axis_name)
+    sumexp = jnp.sum(jnp.exp(logits - m_glob[..., None]), axis=-1)
+    lse = m_glob + jnp.log(jax.lax.psum(sumexp, axis_name))
+    rel = labels_m.astype(jnp.int32) - shard * v_loc
+    in_range = (rel >= 0) & (rel < v_loc)
+    gathered = jnp.take_along_axis(
+        logits, jnp.clip(rel, 0, v_loc - 1)[..., None], axis=-1
+    )[..., 0]
+    label_logit = jax.lax.psum(
+        jnp.where(in_range, gathered, 0.0), axis_name
+    )
+    return jnp.mean(lse - label_logit)
+
+
 def make_lm_pipeline_1f1b(cfg, mesh, n_stages, num_microbatches,
                           axis_name="stage", batch_axis=None):
     """1F1B-scheduled pipelined LM training: returns (init_fn,
@@ -383,36 +421,10 @@ def make_lm_pipeline_1f1b(cfg, mesh, n_stages, num_microbatches,
         return gpipe_init(rng, sample_tokens)
 
     def _head_loss(head_params, y, labels_m, stage):
-        """Vocab-parallel CE for one microbatch: this stage computes its
-        [v_loc] logit slice; pmax/psum over the stage axis assemble the
-        full log-sum-exp and label logit. Returns the mean CE over this
-        shard's tokens."""
-        z = head_ln.apply(
-            {"params": head_params["LayerNorm_0"]}, y
-        ).astype(jnp.float32)
-        kernel = head_params["lm_head"]["kernel"].astype(jnp.float32)
-        bias = head_params["lm_head"]["bias"].astype(jnp.float32)
-        k_loc = jax.lax.dynamic_slice_in_dim(
-            kernel, stage * v_loc, v_loc, axis=1
+        return vocab_parallel_head_loss(
+            cfg, head_ln, v_loc, axis_name, head_params, y, labels_m,
+            stage,
         )
-        b_loc = jax.lax.dynamic_slice_in_dim(bias, stage * v_loc, v_loc, 0)
-        logits = z @ k_loc + b_loc  # [mb, S, v_loc]
-        # stop_gradient BEFORE the pmax: pmax has no differentiation rule,
-        # and the max only stabilizes the exp (its gradient is zero by
-        # construction of the log-sum-exp identity).
-        m_loc = jax.lax.stop_gradient(jnp.max(logits, axis=-1))
-        m_glob = jax.lax.pmax(m_loc, axis_name)
-        sumexp = jnp.sum(jnp.exp(logits - m_glob[..., None]), axis=-1)
-        lse = m_glob + jnp.log(jax.lax.psum(sumexp, axis_name))
-        rel = labels_m.astype(jnp.int32) - stage * v_loc
-        in_range = (rel >= 0) & (rel < v_loc)
-        gathered = jnp.take_along_axis(
-            logits, jnp.clip(rel, 0, v_loc - 1)[..., None], axis=-1
-        )[..., 0]
-        label_logit = jax.lax.psum(
-            jnp.where(in_range, gathered, 0.0), axis_name
-        )
-        return jnp.mean(lse - label_logit)
 
     def _stage_forward(stage_params, embed_params, x_in, tokens_m, stage,
                        training, rng_m):

@@ -29,7 +29,11 @@ from elasticdl_tpu.models.transformer.transformer_lm import (
     Block,
     embed_input,
 )
-from elasticdl_tpu.parallel.pipeline import make_lm_pipeline, microbatch
+from elasticdl_tpu.parallel.pipeline import (
+    make_lm_pipeline,
+    microbatch,
+    vocab_parallel_head_loss,
+)
 from elasticdl_tpu.parallel.pipeline_schedule import (
     build_interleaved_schedule,
 )
@@ -90,30 +94,10 @@ def make_lm_pipeline_interleaved(cfg, mesh, n_stages, v, num_microbatches,
         return gpipe_init(rng, sample_tokens)
 
     def _head_loss(head_params, y, labels_m, shard):
-        """Vocab-parallel CE (same math as make_lm_pipeline_1f1b)."""
-        z = head_ln.apply(
-            {"params": head_params["LayerNorm_0"]}, y
-        ).astype(jnp.float32)
-        kernel = head_params["lm_head"]["kernel"].astype(jnp.float32)
-        bias = head_params["lm_head"]["bias"].astype(jnp.float32)
-        k_loc = jax.lax.dynamic_slice_in_dim(
-            kernel, shard * v_loc, v_loc, axis=1
+        return vocab_parallel_head_loss(
+            cfg, head_ln, v_loc, axis_name, head_params, y, labels_m,
+            shard,
         )
-        b_loc = jax.lax.dynamic_slice_in_dim(bias, shard * v_loc, v_loc, 0)
-        logits = z @ k_loc + b_loc
-        m_loc = jax.lax.stop_gradient(jnp.max(logits, axis=-1))
-        m_glob = jax.lax.pmax(m_loc, axis_name)
-        sumexp = jnp.sum(jnp.exp(logits - m_glob[..., None]), axis=-1)
-        lse = m_glob + jnp.log(jax.lax.psum(sumexp, axis_name))
-        rel = labels_m.astype(jnp.int32) - shard * v_loc
-        in_range = (rel >= 0) & (rel < v_loc)
-        gathered = jnp.take_along_axis(
-            logits, jnp.clip(rel, 0, v_loc - 1)[..., None], axis=-1
-        )[..., 0]
-        label_logit = jax.lax.psum(
-            jnp.where(in_range, gathered, 0.0), axis_name
-        )
-        return jnp.mean(lse - label_logit)
 
     def _chunk_forward(chunk_params, embed_params, x_in, tokens_m,
                        is_first, rng_m):

@@ -225,7 +225,11 @@ def build_interleaved_schedule(n_stages, v, num_micro):
         for intervals in by_slot.values():
             intervals.sort()
             for (s1, r1), (s2, r2) in zip(intervals, intervals[1:]):
-                if s2 < r1:
+                # The kernel writes dy MID-tick (head slot) before the
+                # bwd read, so a same-tick produce/consume pair on one
+                # slot would overwrite first: inclusive overlap, unlike
+                # the end-of-tick mailbox writes above.
+                if s2 <= r1:
                     ok = False
                     break
             if not ok:
