@@ -216,3 +216,42 @@ def test_tensorboard_loadbalancer_service(api_server):
         == "master"
     )
     assert client.get_tensorboard_external_ip() is None  # not assigned yet
+
+
+def test_default_rest_api_sources(monkeypatch, tmp_path):
+    """default_rest_api resolution order: explicit EDL_K8S_API_SERVER,
+    else the in-cluster service account (token + CA files + env), else
+    None."""
+    from elasticdl_tpu.common import k8s_rest
+
+    monkeypatch.delenv("EDL_K8S_API_SERVER", raising=False)
+    monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+    assert k8s_rest.default_rest_api() is None
+    assert not k8s_rest.in_cluster_available()
+
+    monkeypatch.setenv("EDL_K8S_API_SERVER", "http://127.0.0.1:9999")
+    api = k8s_rest.default_rest_api()
+    assert api is not None and api._scheme == "http"
+
+    # In-cluster: service-account dir + env present. The placeholder CA
+    # isn't a parseable PEM, so stub the context factory (its cafile
+    # plumbing is stdlib behavior, not ours).
+    import ssl as _ssl
+
+    monkeypatch.delenv("EDL_K8S_API_SERVER")
+    sa = tmp_path / "sa"
+    sa.mkdir()
+    (sa / "token").write_text("tok-123\n")
+    (sa / "ca.crt").write_text("")
+    monkeypatch.setattr(
+        k8s_rest.ssl,
+        "create_default_context",
+        lambda cafile=None: _ssl.SSLContext(_ssl.PROTOCOL_TLS_CLIENT),
+    )
+    monkeypatch.setattr(k8s_rest, "_SA_DIR", str(sa))
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+    monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "443")
+    assert k8s_rest.in_cluster_available()
+    api = k8s_rest.default_rest_api()
+    assert api._scheme == "https" and api._token == "tok-123"
+    assert api._headers()["Authorization"] == "Bearer tok-123"

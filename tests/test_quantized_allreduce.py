@@ -118,3 +118,31 @@ def test_dp_training_with_quantized_gradients_converges():
         we = exact_step(we, x, y)
     np.testing.assert_allclose(np.asarray(we), true_w, atol=1e-3)
     np.testing.assert_allclose(np.asarray(wq), true_w, atol=0.02)
+
+
+def test_quantized_pmean_bf16_leaves():
+    """bf16 gradient trees round-trip: accumulation runs in f32, outputs
+    restore the leaf dtype."""
+    mesh = _mesh()
+    rng = np.random.default_rng(5)
+    tree = {"w": jnp.asarray(
+        rng.normal(size=(N, 16)).astype(np.float32), jnp.bfloat16
+    )}
+
+    def body(t):
+        local = jax.tree_util.tree_map(lambda a: a[0], t)
+        out = quantized_pmean(local, "data")
+        return jax.tree_util.tree_map(lambda a: a[None], out)
+
+    got = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P("data"), tree),),
+        out_specs=jax.tree_util.tree_map(lambda _: P("data"), tree),
+        check_vma=False,
+    )(tree)
+    assert got["w"].dtype == jnp.bfloat16
+    want = np.asarray(tree["w"], np.float32).mean(axis=0)
+    np.testing.assert_allclose(
+        np.asarray(got["w"], np.float32)[0], want, atol=0.08
+    )
