@@ -46,6 +46,14 @@ def add_common_arguments(parser):
     parser.add_argument("--log_loss_steps", type=int, default=100)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
+        "--ps_wire_dtype",
+        default="float32",
+        choices=["float32", "bfloat16"],
+        help="PS strategy: dtype for embedding values on the wire; "
+        "bfloat16 halves sparse pull/push bandwidth (dense params and "
+        "optimizer state stay float32 on the PS)",
+    )
+    parser.add_argument(
         "--model_parallel_size",
         type=int,
         default=1,

@@ -127,13 +127,16 @@ def ndarray_to_indexed_slices_pb(
         )
     return pb.IndexedSlices(
         concat_tensors=ndarray_to_tensor_pb(values, name),
-        ids=np.asarray(ids, dtype=np.int64).tolist(),
+        ids_bytes=np.ascontiguousarray(ids, dtype=np.int64).tobytes(),
     )
 
 
 def indexed_slices_pb_to_ndarrays(slices_pb: pb.IndexedSlices):
     values = tensor_pb_to_ndarray(slices_pb.concat_tensors)
-    ids = np.asarray(slices_pb.ids, dtype=np.int64)
+    if slices_pb.ids_bytes:
+        ids = np.frombuffer(slices_pb.ids_bytes, dtype=np.int64)
+    else:  # older writers used the repeated form
+        ids = np.asarray(slices_pb.ids, dtype=np.int64)
     return values, ids
 
 
@@ -150,6 +153,25 @@ def merge_indexed_slices(values_list, ids_list):
 
 
 def deduplicate_indexed_slices(values: np.ndarray, ids: np.ndarray):
+    from elasticdl_tpu import native
+
+    lib = native.lib()
+    if (
+        lib is not None
+        and values.ndim == 2
+        and values.dtype == np.float32
+        and len(ids)
+    ):
+        values = np.ascontiguousarray(values)
+        ids = np.ascontiguousarray(ids, dtype=np.int64)
+        out_ids = np.empty(len(ids), dtype=np.int64)
+        out_values = np.empty_like(values)
+        n = lib.edl_dedup_sum(
+            native._i64p(ids), native._f32p(values), len(ids),
+            values.shape[1], native._i64p(out_ids),
+            native._f32p(out_values),
+        )
+        return out_values[:n], out_ids[:n]
     unique_ids, inverse = np.unique(ids, return_inverse=True)
     summed = np.zeros((len(unique_ids),) + values.shape[1:], dtype=values.dtype)
     np.add.at(summed, inverse, values)

@@ -39,6 +39,7 @@ _WORKER_RELAY_ARGS = [
     "distribution_strategy",
     "minibatch_size",
     "get_model_steps",
+    "ps_wire_dtype",
     "log_loss_steps",
     "seed",
     "model_parallel_size",

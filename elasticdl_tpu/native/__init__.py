@@ -54,6 +54,12 @@ def _declare(lib):
         "edl_gather_rows": [f32p, i64p, i64, i64, f32p],
         "edl_scatter_rows": [f32p, i64p, i64, i64, f32p],
         "edl_uniform_init": [f32p, i64, f32, f32, ctypes.c_uint64],
+        "edl_uniform_init_rows": [f32p, i64, i64, i64, f32, f32,
+                                  ctypes.c_uint64],
+        "edl_normal_init_rows": [f32p, i64, i64, i64, f32, f32,
+                                 ctypes.c_uint64, ctypes.c_int],
+        "edl_idmap_free": [ctypes.c_void_p],
+        "edl_idmap_export_ids": [ctypes.c_void_p, i64, i64, i64p],
     }
     for name, argtypes in sigs.items():
         fn = getattr(lib, name)
@@ -66,6 +72,17 @@ def _declare(lib):
         ctypes.c_longlong, i64p,
     ]
     lib.edl_records_read.restype = ctypes.c_longlong
+    # id->row map handle functions (non-void returns).
+    lib.edl_idmap_new.argtypes = [i64]
+    lib.edl_idmap_new.restype = ctypes.c_void_p
+    lib.edl_idmap_size.argtypes = [ctypes.c_void_p]
+    lib.edl_idmap_size.restype = i64
+    lib.edl_idmap_rows_for_ids.argtypes = [
+        ctypes.c_void_p, i64p, i64, ctypes.c_int, i64p,
+    ]
+    lib.edl_idmap_rows_for_ids.restype = i64
+    lib.edl_dedup_sum.argtypes = [i64p, f32p, i64, i64, i64p, f32p]
+    lib.edl_dedup_sum.restype = i64
     return lib
 
 
@@ -88,7 +105,7 @@ def lib():
             _lib = False
             return None
         try:
-            sources = ("kernels.cc", "recordio.cc")
+            sources = ("kernels.cc", "recordio.cc", "idmap.cc")
             if not os.path.exists(_SO) or any(
                 os.path.getmtime(_SO)
                 < os.path.getmtime(os.path.join(_HERE, src))

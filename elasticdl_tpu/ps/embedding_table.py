@@ -5,23 +5,69 @@ Reference counterpart: map[int64]*Tensor with an RWMutex and lazy uniform
 embedding_table.go:22-88) and the Python dict twin
 (elasticdl/python/ps/embedding_table.py:23-136). Redesign: rows live in ONE
 contiguous [capacity, dim] float32 slab that doubles on growth, with an
-id -> row-index dict on the side. That layout is what lets the native
+id -> row-index map on the side. That layout is what lets the native
 optimizer kernels update k sparse rows in a single C call, and what makes
 lookups a single gather instead of k dict hits.
+
+The id -> row map itself is native too (native/idmap.cc): the reference's
+production PS resolves ids in compiled Go/C++ (go/pkg/ps/server.go:176-206),
+and the measured cost of doing it in Python was ~2.5 s per 320k-id pull —
+one dict hit plus one ctypes init call per id. One C call now resolves the
+whole id batch and bulk-initializes the fresh rows. Rows are assigned in
+first-seen order, so row i <-> the i-th distinct id and a checkpoint page is
+a contiguous slab slice.
 
 Slot tables (Adam m/v, momentum velocity, ...) are companion slabs allocated
 by the optimizer with the SAME row mapping, so one row-index array drives the
 parameter and all its slots.
 """
 
+import ctypes
 import threading
 
 import numpy as np
 
 from elasticdl_tpu import native
-from elasticdl_tpu.ps.initializers import make_row_initializer
+from elasticdl_tpu.ps.initializers import (
+    make_row_initializer,
+    resolve_native_init,
+)
 
 DEFAULT_CAPACITY = 1024
+
+
+class _NativeIdMap:
+    """ctypes wrapper over the C open-addressing id->row map."""
+
+    def __init__(self, lib, capacity):
+        self._lib = lib
+        self._handle = lib.edl_idmap_new(capacity)
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None and self._handle:
+            lib.edl_idmap_free(self._handle)
+            self._handle = None
+
+    def __len__(self):
+        return self._lib.edl_idmap_size(self._handle)
+
+    def rows_for_ids(self, ids, create_missing):
+        """-> (rows [n] int64, size_after). New rows are exactly
+        [size_before, size_after) in first-seen order."""
+        rows = np.empty(len(ids), dtype=np.int64)
+        size_after = self._lib.edl_idmap_rows_for_ids(
+            self._handle, native._i64p(ids), len(ids),
+            1 if create_missing else 0, native._i64p(rows),
+        )
+        return rows, size_after
+
+    def export_ids(self, start, count):
+        out = np.empty(count, dtype=np.int64)
+        self._lib.edl_idmap_export_ids(
+            self._handle, start, count, native._i64p(out)
+        )
+        return out
 
 
 class EmbeddingTable:
@@ -33,15 +79,27 @@ class EmbeddingTable:
         self.dtype = np.dtype(dtype)
         # Full initializer library (zeros/constant/uniform/normal/
         # truncated_normal, optionally parameterized — ps/initializers.py,
-        # matching the reference's initializer.go). Uniform specs resolve
-        # to a (low, high) range that _init_row feeds the fast native
-        # kernel; everything else goes through the numpy closure.
-        self._init_fn, self._uniform_range = make_row_initializer(
+        # matching the reference's initializer.go). Specs the native bulk
+        # kernels understand resolve to a flat descriptor; everything else
+        # goes through the per-row numpy closure. Random init values are
+        # deterministic per (seed, row) WITHIN a backend, but the native and
+        # numpy generators are different streams — a restore that re-inits
+        # unseen ids reproduces exactly only on a host with the same
+        # backend available (true for uniform since round 1; normal joined
+        # the native path in round 4).
+        self._init_fn, _ = make_row_initializer(
             initializer, self.dim, self.dtype
         )
+        self._native_init = resolve_native_init(initializer)
         self._lock = threading.RLock()
         self._slab = np.zeros((capacity, self.dim), dtype=self.dtype)
-        self._id_to_row = {}
+        lib = native.lib()
+        if lib is not None:
+            self._map = _NativeIdMap(lib, capacity)
+            self._id_to_row = None
+        else:
+            self._map = None
+            self._id_to_row = {}
         self._seed = seed
         # Companion slabs (optimizer slots) registered via create_slot;
         # grown in lockstep with the parameter slab.
@@ -51,13 +109,19 @@ class EmbeddingTable:
     # ---------- row management ----------
 
     def __len__(self):
-        return len(self._id_to_row)
+        with self._lock:
+            if self._map is not None:
+                return len(self._map)
+            return len(self._id_to_row)
 
     @property
     def ids(self):
         with self._lock:
+            if self._map is not None:
+                return self._map.export_ids(0, len(self._map))
             return np.fromiter(
-                self._id_to_row.keys(), dtype=np.int64, count=len(self._id_to_row)
+                self._id_to_row.keys(), dtype=np.int64,
+                count=len(self._id_to_row),
             )
 
     def _grow(self, min_capacity):
@@ -73,32 +137,63 @@ class EmbeddingTable:
             g[: slab.shape[0]] = slab
             self._slots[slot_name] = g
 
-    def _init_row(self, row):
-        dst = self._slab[row]
+    def _row_seed(self, row):
         # Deterministic per-row seed so a resharded restore that re-inits
         # unseen ids stays reproducible.
-        seed = (self._seed * 0x9E3779B1 + row + 1) & 0xFFFFFFFFFFFFFFFF
+        return (self._seed * 0x9E3779B1 + row + 1) & 0xFFFFFFFFFFFFFFFF
+
+    def _init_rows(self, start, n):
+        """Initialize the fresh contiguous rows [start, start+n). Called
+        under the lock, after any grow."""
+        if n <= 0:
+            return
         lib = native.lib()
-        if (
-            self._uniform_range is not None
-            and lib is not None
-            and self.dtype == np.float32
-        ):
-            low, high = self._uniform_range
-            lib.edl_uniform_init(
-                dst.ctypes.data_as(native.ctypes.POINTER(
-                    native.ctypes.c_float)),
-                self.dim, low, high, seed,
-            )
-        else:
-            self._init_fn(dst, seed)
+        spec = self._native_init
+        if lib is not None and self.dtype == np.float32 and spec is not None:
+            if spec[0] == "zeros":
+                return  # grown slab area is already zeroed
+            if spec[0] == "constant":
+                self._slab[start:start + n] = spec[1]
+                return
+            slab_p = native._f32p(self._slab)
+            if spec[0] == "uniform":
+                lib.edl_uniform_init_rows(
+                    slab_p, self.dim, start, n, spec[1], spec[2],
+                    ctypes.c_uint64(self._seed),
+                )
+                return
+            if spec[0] == "normal":
+                lib.edl_normal_init_rows(
+                    slab_p, self.dim, start, n, spec[1], spec[2],
+                    ctypes.c_uint64(self._seed), 1 if spec[3] else 0,
+                )
+                return
+        for row in range(start, start + n):
+            self._init_row(row)
+
+    def _init_row(self, row):
+        # Pure-python per-row fallback: runs only when the native lib is
+        # absent (no map, no bulk kernels) or for specs/dtypes the bulk
+        # kernels don't cover.
+        self._init_fn(self._slab[row], self._row_seed(row))
 
     def rows_for_ids(self, ids, create_missing=True):
         """id array -> row-index array, lazily materializing unseen ids (the
         'lazy init on first lookup' semantics)."""
-        ids = np.asarray(ids, dtype=np.int64)
-        rows = np.empty(len(ids), dtype=np.int64)
+        ids = np.ascontiguousarray(ids, dtype=np.int64)
         with self._lock:
+            if self._map is not None:
+                size_before = len(self._map)
+                rows, size_after = self._map.rows_for_ids(
+                    ids, create_missing
+                )
+                n_new = size_after - size_before
+                if n_new:
+                    if size_after > self._slab.shape[0]:
+                        self._grow(size_after)
+                    self._init_rows(size_before, n_new)
+                return rows
+            rows = np.empty(len(ids), dtype=np.int64)
             for i, id_ in enumerate(ids):
                 row = self._id_to_row.get(int(id_))
                 if row is None:
@@ -111,7 +206,7 @@ class EmbeddingTable:
                     self._id_to_row[int(id_)] = row
                     self._init_row(row)
                 rows[i] = row
-        return rows
+            return rows
 
     # ---------- lookup / assign ----------
 
@@ -172,16 +267,23 @@ class EmbeddingTable:
     def export_rows(self, start=0, count=None):
         """(ids, values) for materialized ids in stable insertion order,
         row-aligned. `start`/`count` page through the table (new ids only
-        ever append, so earlier pages stay stable while paging)."""
+        ever append, so earlier pages stay stable while paging). Row i was
+        created by the i-th distinct id, so a page's values are the
+        contiguous slab slice [start, end)."""
         with self._lock:
-            ids = self.ids
-            rows = np.fromiter(
-                self._id_to_row.values(), dtype=np.int64, count=len(ids)
-            )
-            if count is not None or start:
-                end = len(ids) if count is None else start + count
-                ids, rows = ids[start:end], rows[start:end]
-            return ids, self._slab[rows].copy()
+            n = len(self)
+            end = n if count is None else min(n, start + count)
+            if start >= end:
+                return np.empty(0, np.int64), np.empty(
+                    (0, self.dim), self.dtype
+                )
+            if self._map is not None:
+                ids = self._map.export_ids(start, end - start)
+            else:
+                ids = np.fromiter(
+                    self._id_to_row.keys(), dtype=np.int64, count=n
+                )[start:end]
+            return ids, self._slab[start:end].copy()
 
     def import_rows(self, ids, values):
         self.assign(ids, values)

@@ -43,12 +43,36 @@ def _truncated_normal(rng, mean, std, n):
     return out
 
 
+def resolve_native_init(spec):
+    """spec string -> a flat descriptor the native bulk-init kernels
+    understand, or None when only the numpy closure can produce it.
+
+    ("uniform", low, high) | ("normal", mean, std, truncated) |
+    ("constant", value) | ("zeros",)
+    """
+    name, args = parse_initializer_spec(spec)
+    if name in ("zero", "zeros"):
+        return ("zeros",)
+    if name == "constant":
+        return ("constant", args[0] if args else 0.0)
+    if name in ("uniform", "random_uniform"):
+        low = args[0] if args else DEFAULT_UNIFORM_LOW
+        high = args[1] if len(args) > 1 else DEFAULT_UNIFORM_HIGH
+        return ("uniform", low, high)
+    if name in ("normal", "random_normal", "truncated_normal"):
+        mean = args[0] if args else DEFAULT_NORMAL_MEAN
+        std = args[1] if len(args) > 1 else DEFAULT_NORMAL_STD
+        return ("normal", mean, std, name == "truncated_normal")
+    return None
+
+
 def make_row_initializer(spec, dim, dtype=np.float32):
     """spec string -> fn(dst_row, seed) filling one [dim] row in place.
 
     Returns (fn, uniform_range): uniform_range is the resolved (low, high)
-    for uniform specs — the single source of truth the caller may hand to
-    the native C uniform kernel instead of calling fn — and None otherwise.
+    for uniform specs and None otherwise. (The native bulk-init path
+    resolves specs through resolve_native_init instead; fn is the
+    pure-numpy fallback stream.)
     """
     name, args = parse_initializer_spec(spec)
     if name in ("zero", "zeros"):

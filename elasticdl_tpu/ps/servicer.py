@@ -120,9 +120,15 @@ class PserverServicer:
         table = self._params.embedding_tables.get(request.name)
         if table is None:
             raise ValueError(f"unknown embedding table {request.name!r}")
-        if not request.ids:
+        if request.ids_bytes:
+            ids = np.frombuffer(request.ids_bytes, dtype=np.int64)
+        elif request.ids:
+            ids = np.asarray(request.ids, dtype=np.int64)
+        else:
             return pb.Tensor(name=request.name)
-        values = table.lookup(np.asarray(request.ids, dtype=np.int64))
+        values = table.lookup(ids)
+        if request.value_dtype == pb.DT_BFLOAT16:
+            values = values.astype(tensor_utils.bfloat16)
         return tensor_utils.ndarray_to_tensor_pb(values, request.name)
 
     def pull_embedding_table(self, request, context):
@@ -183,7 +189,9 @@ class PserverServicer:
                     accepted=False, version=self._params.version
                 )
             for t in request.gradients.dense_parameters:
-                arr = tensor_utils.tensor_pb_to_ndarray(t)
+                arr = tensor_utils.tensor_pb_to_ndarray(t).astype(
+                    np.float32, copy=False
+                )
                 if t.name in self._grad_sum:
                     self._grad_sum[t.name] += arr
                 else:
@@ -192,6 +200,9 @@ class PserverServicer:
                 values, ids = tensor_utils.indexed_slices_pb_to_ndarrays(
                     slices
                 )
+                # bf16 wire payloads accumulate in f32 (precision of the
+                # merge must not depend on the wire dtype).
+                values = values.astype(np.float32, copy=False)
                 acc = self._sparse_acc.setdefault(name, ([], []))
                 acc[0].append(values)
                 acc[1].append(ids)

@@ -35,7 +35,11 @@ def build_trainer(args, spec, master_client):
             model,
             spec.loss,
             optimizer_spec,
-            PSClient(args.ps_addrs.split(","), worker_id=args.worker_id),
+            PSClient(
+                args.ps_addrs.split(","),
+                worker_id=args.worker_id,
+                wire_dtype=args.ps_wire_dtype,
+            ),
             embedding_inputs=getattr(spec.module, "embedding_inputs", None),
             embedding_threshold_bytes=getattr(
                 spec.module, "embedding_threshold_bytes", None

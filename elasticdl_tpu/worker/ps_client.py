@@ -15,8 +15,18 @@ from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
 
 class PSClient:
-    def __init__(self, ps_addrs, worker_id=-1):
-        """ps_addrs: list of "host:port", index = ps_id."""
+    def __init__(self, ps_addrs, worker_id=-1, wire_dtype="float32"):
+        """ps_addrs: list of "host:port", index = ps_id.
+
+        wire_dtype: dtype for embedding values on the wire ("float32" or
+        "bfloat16"). bf16 halves pull/push bandwidth for the sparse hot
+        path; dense parameters/gradients always travel f32 (they are small
+        and the optimizer moments live in f32). The reference kept its wire
+        f32 because its PS was never host-bandwidth-bound; a Python-process
+        PS is, so this is the EQuARX-analog lever for the PS strategy."""
+        if wire_dtype not in ("float32", "bfloat16"):
+            raise ValueError(f"unsupported wire_dtype {wire_dtype!r}")
+        self._bf16_wire = wire_dtype == "bfloat16"
         self._addrs = list(ps_addrs)
         self._worker_id = worker_id
         self._channels = [rpc.build_channel(a) for a in self._addrs]
@@ -124,12 +134,15 @@ class PSClient:
         if ids.size == 0:
             return None
         scattered = hash_utils.scatter_embedding_ids(ids, self.num_ps)
+        value_dtype = pb.DT_BFLOAT16 if self._bf16_wire else pb.DT_INVALID
         futures = {
             ps_id: (
                 positions,
                 self._stubs[ps_id].pull_embedding_vectors.future(
                     pb.PullEmbeddingVectorsRequest(
-                        name=name, ids=shard_ids.tolist()
+                        name=name,
+                        ids_bytes=np.ascontiguousarray(shard_ids).tobytes(),
+                        value_dtype=value_dtype,
                     )
                 ),
             )
@@ -138,6 +151,8 @@ class PSClient:
         out = None
         for ps_id, (positions, f) in futures.items():
             values = tensor_utils.tensor_pb_to_ndarray(f.result())
+            if values.dtype != np.float32:
+                values = values.astype(np.float32)
             if out is None:
                 out = np.empty(
                     (len(ids), values.shape[1]), dtype=values.dtype
@@ -215,6 +230,8 @@ class PSClient:
                 np.asarray(values, dtype=np.float32),
                 np.asarray(ids, dtype=np.int64),
             )
+            if self._bf16_wire:
+                values = values.astype(tensor_utils.bfloat16)
             for ps_id, (shard_ids, positions) in (
                 hash_utils.scatter_embedding_ids(ids, self.num_ps).items()
             ):
