@@ -152,18 +152,23 @@ def bench_deepfm_criteo(batch_size=32768, steps=30, warmup=5):
     }
 
 
-def bench_deepfm_ps(batch_size=16384, steps=8, warmup=2, num_ps=2):
+def bench_deepfm_ps(batch_size=16384, steps=6, warmup=2, num_ps=2,
+                    repeats=2):
     # Batch 16384, not smaller: the push-thread overlap needs enough
     # per-step RPC work to amortize its contention with prefetch on a
     # single-core host (measured 1.22x at 16384 but 0.92x at 8192).
     """The other half of the DeepFM north star (BASELINE.json: "large
     embedding_service + elastic worker preemption"): DeepFM with its
     wide/deep tables PS-RESIDENT on 2 real localhost PS shards (native
-    C++ kernels), one TPU worker pulling rows / pushing IndexedSlices
-    per step (models/dac_ctr/deepfm_ps). Measured both ways: the
-    pipelined async path (push on a background thread, pulls overlapping
-    the previous step's device compute) vs the fully serialized loop —
-    the before/after of the round-3 overlap work."""
+    C++ id map + kernels), one TPU worker pulling rows / pushing
+    IndexedSlices per step (models/dac_ctr/deepfm_ps). Three configs:
+    the serialized loop (f32 and bf16 wire) and the pipelined async
+    path (push on a background thread). Every config runs `repeats`
+    times and reports its BEST run — this bench shares one host core
+    with both PS shards, so single runs swing with transient host load
+    (VERDICT r3: a 2x swing between driver and builder runs of the
+    identical config); the best-of-N is the reproducible number, and
+    loadavg is recorded for context."""
     from elasticdl_tpu.common.model_utils import get_model_spec
     from elasticdl_tpu.models.dac_ctr.transform import NUM_FIELDS, TOTAL_IDS
     from elasticdl_tpu.ps.parameter_server import ParameterServer
@@ -184,8 +189,7 @@ def bench_deepfm_ps(batch_size=16384, steps=8, warmup=2, num_ps=2):
         labels = rng.integers(0, 2, batch_size).astype(np.int64)
         batches.append((features, labels))
 
-    out = {}
-    for mode, pipelined in (("serialized", False), ("pipelined", True)):
+    def run_once(pipelined, wire_dtype):
         servers = [
             ParameterServer(
                 i, num_ps, optimizer_spec=spec.build_optimizer_spec()
@@ -196,7 +200,8 @@ def bench_deepfm_ps(batch_size=16384, steps=8, warmup=2, num_ps=2):
         trainer = None
         try:
             client = PSClient(
-                [s.addr for s in servers], worker_id=0
+                [s.addr for s in servers], worker_id=0,
+                wire_dtype=wire_dtype,
             )
             trainer = ParameterServerTrainer(
                 spec.build_model(),
@@ -223,7 +228,7 @@ def bench_deepfm_ps(batch_size=16384, steps=8, warmup=2, num_ps=2):
                 phase: round(s["mean_s"] * 1e3, 2)
                 for phase, s in trainer.timing.summary().items()
             }
-            out[mode] = {
+            return {
                 "examples_per_sec": batch_size * steps / elapsed,
                 "step_time_ms": elapsed / steps * 1e3,
                 "phase_mean_ms": phases,
@@ -235,9 +240,28 @@ def bench_deepfm_ps(batch_size=16384, steps=8, warmup=2, num_ps=2):
                 client.close()
             for s in servers:
                 s.stop()
+
+    configs = (
+        ("serialized", False, "float32"),
+        ("serialized_bf16_wire", False, "bfloat16"),
+        ("pipelined", True, "float32"),
+    )
+    out = {"best_of_n": repeats, "loadavg_start": os.getloadavg()[0]}
+    for name, pipelined, wire in configs:
+        runs = [run_once(pipelined, wire) for _ in range(repeats)]
+        best = max(runs, key=lambda r: r["examples_per_sec"])
+        best["runs_examples_per_sec"] = [
+            round(r["examples_per_sec"], 1) for r in runs
+        ]
+        out[name] = best
+    out["loadavg_end"] = os.getloadavg()[0]
     if out.get("serialized", {}).get("examples_per_sec"):
         out["overlap_speedup"] = (
             out["pipelined"]["examples_per_sec"]
+            / out["serialized"]["examples_per_sec"]
+        )
+        out["bf16_wire_speedup"] = (
+            out["serialized_bf16_wire"]["examples_per_sec"]
             / out["serialized"]["examples_per_sec"]
         )
     return out
@@ -267,20 +291,30 @@ def bench_elastic_rejoin():
             with RecordFileWriter(data) as w:
                 for r in test_module.make_linear_records(256):
                     w.write(r)
-            result = run_drill(
-                data,
-                model_zoo=os.path.join(repo, "tests"),
-                model_def="test_module",
-                num_workers=2,
-                num_ps=1,
-                num_epochs=300,
-                env_overrides={"JAX_PLATFORMS": "cpu"},
-                timeout=600,
-            )
+            # Best-of-2: rejoin time is control-plane latency on a shared
+            # single-core host; one run can absorb seconds of unrelated
+            # load (VERDICT r3 asked every host-bound bench for best-of-N).
+            results = [
+                run_drill(
+                    data,
+                    model_zoo=os.path.join(repo, "tests"),
+                    model_def="test_module",
+                    num_workers=2,
+                    num_ps=1,
+                    num_epochs=300,
+                    env_overrides={"JAX_PLATFORMS": "cpu"},
+                    timeout=600,
+                )
+                for _ in range(2)
+            ]
+        ok = [r for r in results if r.get("rejoin_s") is not None]
+        best = min(ok, key=lambda r: r["rejoin_s"]) if ok else results[0]
         return {
-            "rejoin_s": result.get("rejoin_s"),
-            "completed": result.get("completed"),
-            "relaunched": result.get("relaunched"),
+            "rejoin_s": best.get("rejoin_s"),
+            "rejoin_s_runs": [r.get("rejoin_s") for r in results],
+            "best_of_n": 2,
+            "completed": best.get("completed"),
+            "relaunched": best.get("relaunched"),
         }
     except Exception as e:  # never let the drill sink the whole bench
         return {"rejoin_s": None, "error": str(e)[:200]}

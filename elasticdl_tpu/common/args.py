@@ -9,7 +9,10 @@ is 1). Three-stage relay kept: CLI flags -> master argv -> worker/PS argv
 import argparse
 import os
 
-from elasticdl_tpu.common.constants import DistributionStrategy
+from elasticdl_tpu.common.constants import (
+    COORDINATOR_PORT_ROTATION,
+    DistributionStrategy,
+)
 
 
 def add_common_arguments(parser):
@@ -178,7 +181,11 @@ def add_cluster_arguments(parser):
         "--coordinator_port",
         type=int,
         default=51000,
-        help="jax.distributed coordination-service port on rank 0",
+        help="jax.distributed coordination-service port on rank 0. The "
+        "port ROTATES across membership epochs: the job reserves the "
+        "16-port block [port, port+15], which firewalls/NetworkPolicies "
+        "must open and no other service (master_port, PS ports) may "
+        "occupy",
     )
     parser.add_argument(
         "--task_timeout_check_seconds", type=float, default=30.0
@@ -241,6 +248,23 @@ def validate_args(args):
         raise ValueError(
             "--num_workers >= 1 is required (or --instance_backend none "
             "when workers are launched externally)"
+        )
+    # The coordination port rotates over a 16-port block across membership
+    # epochs (master/membership.py): a master_port inside the block would
+    # collide with a re-rendezvous after some elastic event.
+    coordinator_port = getattr(args, "coordinator_port", None)
+    master_port = getattr(args, "master_port", None)
+    width = COORDINATOR_PORT_ROTATION
+    if (
+        coordinator_port is not None
+        and master_port is not None
+        and master_port != 0
+        and coordinator_port <= master_port < coordinator_port + width
+    ):
+        raise ValueError(
+            f"--master_port {master_port} falls inside the reserved "
+            f"coordination-port rotation block [{coordinator_port}, "
+            f"{coordinator_port + width - 1}]; move one of them"
         )
 
 

@@ -37,3 +37,9 @@ COMM_WORLD_CHECK_STEPS = 20
 
 # Allreduce communication retry cap (reference allreduce_trainer.py:125-139).
 MAX_ALLREDUCE_RETRY_NUM = 5
+
+# Width of the jax.distributed coordination-port rotation window: across
+# membership epochs rank 0 binds coordinator_port + (epoch % width), so the
+# job reserves the block [coordinator_port, coordinator_port + width - 1]
+# (master/membership.py:get_comm_rank; validate_args keeps master_port out).
+COORDINATOR_PORT_ROTATION = 16

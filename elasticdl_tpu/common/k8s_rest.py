@@ -151,6 +151,13 @@ class RestApi:
             "DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}"
         )
 
+    def list_pods(self, namespace, label_selector):
+        return self._request(
+            "GET",
+            f"/api/v1/namespaces/{namespace}/pods"
+            f"?labelSelector={quote(label_selector)}",
+        )
+
     def create_service(self, namespace, manifest):
         return self._request(
             "POST", f"/api/v1/namespaces/{namespace}/services", manifest
@@ -167,15 +174,63 @@ class RestApi:
         (each a JSON line of the chunked response) into `event_callback`
         as {"type": ..., "object": ObjView} until stop_event is set. The
         stream is re-established on any error, matching the official
-        watch's reconnect behavior."""
+        watch's reconnect behavior.
+
+        Every REconnect is a LIST+WATCH (the official client's Reflector
+        pattern): a bare watch starts from "now", so pod transitions that
+        happened while the stream was down would be lost forever — a
+        worker that died in that window would never be relaunched. The
+        re-list (a) synthesizes a MODIFIED event per currently matching
+        pod (consumers treat repeated same-phase MODIFIEDs as no-ops),
+        (b) diffs against the pods seen so far to synthesize DELETED for
+        any that vanished during the outage, and (c) anchors the new
+        watch at the list's resourceVersion so transitions between the
+        LIST response and the WATCH being accepted are replayed, not
+        skipped. An expired anchor (410 Gone) just resets the stream:
+        the next iteration re-lists and gets a fresh one."""
         stop_event = stop_event or threading.Event()
-        path = (
+        base = (
             f"/api/v1/namespaces/{namespace}/pods"
             f"?watch=true&labelSelector={quote(label_selector)}"
         )
+        known = {}  # pod name -> last seen raw object
+        first_connect = True
+        resource_version = None
         while not stop_event.is_set():
             conn = None
             try:
+                if not first_connect:
+                    # Re-list to cover the blind window. (On the first
+                    # connect there is nothing to have missed yet — the
+                    # watch starts before any pod is created.)
+                    listing = self.list_pods(namespace, label_selector)
+                    resource_version = (
+                        listing.get("metadata", {}).get("resourceVersion")
+                    )
+                    current = {}
+                    for item in listing.get("items", []):
+                        name = (item.get("metadata") or {}).get("name")
+                        if name:
+                            current[name] = item
+                    vanished = [
+                        known[n] for n in known if n not in current
+                    ]
+                    known = current
+                    for item in vanished:
+                        if stop_event.is_set():
+                            return
+                        event_callback(
+                            {"type": "DELETED", "object": ObjView(item)}
+                        )
+                    for item in current.values():
+                        if stop_event.is_set():
+                            return
+                        event_callback(
+                            {"type": "MODIFIED", "object": ObjView(item)}
+                        )
+                path = base
+                if resource_version:
+                    path += f"&resourceVersion={quote(resource_version)}"
                 conn = self._connect(timeout=300)
                 conn.request("GET", path, headers=self._headers())
                 res = conn.getresponse()
@@ -183,6 +238,7 @@ class RestApi:
                     raise K8sApiError(
                         res.status, res.read().decode("utf-8", "replace")
                     )
+                first_connect = False
                 while not stop_event.is_set():
                     line = res.readline()
                     if not line:
@@ -191,16 +247,23 @@ class RestApi:
                     if not line:
                         continue
                     event = json.loads(line)
+                    obj = event.get("object") or {}
+                    name = (obj.get("metadata") or {}).get("name")
+                    if name:
+                        if event.get("type") == "DELETED":
+                            known.pop(name, None)
+                        else:
+                            known[name] = obj
                     event_callback(
-                        {
-                            "type": event.get("type"),
-                            "object": ObjView(event.get("object") or {}),
-                        }
+                        {"type": event.get("type"), "object": ObjView(obj)}
                     )
             except Exception:
                 if stop_event.is_set():
                     return
                 logger.warning("k8s watch stream reset", exc_info=True)
+                # A 410-expired anchor must not wedge the loop on the same
+                # stale version; the re-list above refreshes it anyway.
+                resource_version = None
                 stop_event.wait(1.0)
             finally:
                 if conn is not None:

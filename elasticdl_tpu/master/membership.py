@@ -12,6 +12,9 @@ positions in the time-sorted host list, so they are stable for survivors.
 
 import threading
 
+from elasticdl_tpu.common.constants import (
+    COORDINATOR_PORT_ROTATION as PORT_ROTATION,
+)
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger("master.membership")
@@ -99,8 +102,11 @@ class MembershipManager:
             # Rotate the coordination-service port across epochs: the new
             # rank-0 process re-binds immediately after a teardown, and a
             # fixed port can linger in TIME_WAIT (or still be held by a
-            # dying former coordinator).
-            port = self._coordinator_port + (self._group_id % 16)
+            # dying former coordinator). The rotation claims the block
+            # [coordinator_port, coordinator_port + PORT_ROTATION - 1];
+            # firewalls/NetworkPolicies must open the whole block, and
+            # validate_args rejects a master_port inside it.
+            port = self._coordinator_port + (self._group_id % PORT_ROTATION)
             return (
                 rank,
                 len(self._hosts),

@@ -198,18 +198,29 @@ def build_interleaved_schedule(n_stages, v, num_micro):
         bwd_pairs,
     )
     # Stash: input of F(c,m) lives until B(c,m); per local chunk, keyed by
-    # m % depth — depth must exceed the max number of microbatches of one
-    # chunk simultaneously in flight.
+    # m % depth. Sized by the same exact modulo-collision check as the
+    # mailboxes/dy_store — not a max-overlap heuristic, whose sufficiency
+    # would silently depend on the scheduler processing each chunk's
+    # microbatches strictly in order. Inclusive same-tick rule: the fwd
+    # write of one microbatch and the bwd read of another land mid-tick,
+    # so a shared slot on the same tick is a collision.
+    def _stash_collides(depth):
+        for c in range(total):
+            by_slot = {}
+            for m in range(m_total):
+                by_slot.setdefault(m % depth, []).append(
+                    (f_done[c, m], b_done[c, m])
+                )
+            for intervals in by_slot.values():
+                intervals.sort()
+                for (s1, r1), (s2, r2) in zip(intervals, intervals[1:]):
+                    if s2 <= r1:
+                        return True
+        return False
+
     depth = 1
-    for c in range(total):
-        events = sorted(
-            (f_done[c, m], b_done[c, m]) for m in range(m_total)
-        )
-        for i, (s1, e1) in enumerate(events):
-            overlap = sum(
-                1 for s2, e2 in events if s2 <= e1 and e2 >= s1
-            )
-            depth = max(depth, overlap)
+    while _stash_collides(depth):
+        depth += 1
     # dy for the last chunk's bwd: produced by the head at the last
     # chunk's fwd tick, consumed at its bwd tick (same tick allowed);
     # keyed m % dy_store.

@@ -45,7 +45,8 @@ def _dequantize(q, scale):
 
 def quantized_psum_1d(x, axis_name):
     """Allreduce-sum a flat f32 [L] vector over `axis_name` with int8 wire
-    payloads (L must divide the axis size). Call inside shard_map."""
+    payloads (the axis size must divide L: the reshape below splits x into
+    one block per replica). Call inside shard_map."""
     n = jax.lax.psum(1, axis_name)
     blocks = x.reshape(n, -1)  # block b is replica b's return shard
     q, scale = _quantize(blocks)

@@ -138,6 +138,12 @@ class FakeK8sApiServer:
                     while True:
                         event = q.get()
                         if event is None:
+                            # Terminate the chunked body and drop the
+                            # connection so the client sees EOF (the real
+                            # apiserver closes ended watch streams too).
+                            self.wfile.write(b"0\r\n\r\n")
+                            self.wfile.flush()
+                            self.close_connection = True
                             break
                         line = (json.dumps(event) + "\n").encode()
                         self.wfile.write(
@@ -172,6 +178,16 @@ class FakeK8sApiServer:
             q.put(None)
         self._httpd.shutdown()
         self._httpd.server_close()
+
+    def reset_streams(self):
+        """Close every open watch stream (apiserver restart / LB idle
+        reset analog) without stopping the server — events emitted before
+        the client reconnects land in no queue, i.e. a real blind window."""
+        with self._lock:
+            watchers = list(self._watchers)
+            self._watchers = []
+        for _, _, q in watchers:
+            q.put(None)
 
     # ---------- state helpers (tests drive pod phases) ----------
 

@@ -1,0 +1,39 @@
+"""Cross-flag validation rules (common/args.py:validate_args)."""
+
+import pytest
+
+from elasticdl_tpu.common.args import master_parser, validate_args
+
+
+def _parse(*extra):
+    return master_parser().parse_args(
+        ["--model_zoo", "z", "--model_def", "m", *extra]
+    )
+
+
+def test_master_port_inside_coordinator_rotation_block_rejected():
+    # The coordination port rotates over [coordinator_port,
+    # coordinator_port+15] across membership epochs; a master_port inside
+    # the block would collide after some elastic event.
+    args = _parse(
+        "--coordinator_port", "51000", "--master_port", "51007",
+        "--num_workers", "1",
+    )
+    with pytest.raises(ValueError, match="rotation block"):
+        validate_args(args)
+
+
+def test_master_port_outside_rotation_block_ok():
+    args = _parse(
+        "--coordinator_port", "51000", "--master_port", "51016",
+        "--num_workers", "1",
+    )
+    validate_args(args)
+
+
+def test_async_with_quorum_rejected():
+    args = _parse(
+        "--use_async", "--grads_to_wait", "2", "--num_workers", "1"
+    )
+    with pytest.raises(ValueError, match="grads_to_wait"):
+        validate_args(args)

@@ -80,6 +80,66 @@ def test_rest_api_pod_crud(api_server):
         api.read_pod("default", "p0")
 
 
+def test_watch_reconnect_covers_blind_window(api_server):
+    """A watch stream reset must not lose transitions that happened while
+    the stream was down: the reconnect re-lists and synthesizes MODIFIED
+    for current pods and DELETED for pods that vanished (ADVICE r3 +
+    review: a bare reconnect watches from 'now' and the blind window's
+    deletions have no list entry to diff against)."""
+    import threading
+
+    api = RestApi(api_server.endpoint)
+    for name in ("w-a", "w-b"):
+        api.create_pod(
+            "default",
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {"name": name, "labels": {"job": "j"}},
+                "spec": {"containers": []},
+            },
+        )
+    events = []
+    stop = threading.Event()
+    t = threading.Thread(
+        target=api.watch_pods,
+        args=("default", "job=j", lambda e: events.append(e), stop),
+        daemon=True,
+    )
+    t.start()
+    _wait_for(lambda: len(events) >= 2, what="initial ADDED events")
+
+    # Blind window: server drops every stream, then w-b is deleted and
+    # w-a flips to Failed before any client is reconnected.
+    api_server.reset_streams()
+    api.delete_pod("default", "w-b")
+    api_server.set_pod_phase("default", "w-a", "Failed")
+
+    def saw(kind, name):
+        return any(
+            e["type"] == kind and e["object"].metadata.name == name
+            for e in events
+        )
+
+    _wait_for(lambda: saw("DELETED", "w-b"), what="synthesized DELETED")
+    # The Failed phase may arrive as a synthesized re-list MODIFIED or on
+    # the new stream (the fake replays current state as ADDED on connect,
+    # depending on how the reconnect races the phase change) — what
+    # matters is that it arrives at all.
+    _wait_for(
+        lambda: any(
+            e["object"].metadata.name == "w-a"
+            and e["object"].status
+            and e["object"].status.phase == "Failed"
+            for e in events
+        ),
+        what="Failed phase after reconnect",
+    )
+    stop.set()
+    api_server.reset_streams()  # unblock the watcher thread
+    t.join(timeout=5)
+
+
 def test_edl_train_submits_master_pod(api_server, tmp_path):
     """The never-before-executed path (VERDICT r2 missing #2): a real
     `edl train --instance_backend k8s` submission creating the master pod
