@@ -175,7 +175,19 @@ def add_cluster_arguments(parser):
         help="shard optimizer state over the data axis (cross-replica "
         "weight-update sharding): per-chip optimizer memory drops by "
         "the DP degree, update compiles as reduce-scatter -> "
-        "shard-local math -> all-gather",
+        "shard-local math -> all-gather. In multi-host worlds the shard "
+        "axis is the intra-process device slice (memory drops by the "
+        "local chip count) so elastic regroups keep a full copy per "
+        "process",
+    )
+    parser.add_argument(
+        "--quantized_grads",
+        action="store_true",
+        default=False,
+        help="AllReduce strategy: reduce DP gradients with int8 wire "
+        "payloads (EQuARX-style reduce-scatter + all-gather, ~4x less "
+        "collective bandwidth); on multi-host meshes only the "
+        "cross-process leg quantizes, intra-host stays exact f32",
     )
     parser.add_argument(
         "--coordinator_port",
@@ -324,6 +336,9 @@ def worker_parser():
     )
     p.add_argument("--multi_host", action="store_true", default=False)
     p.add_argument("--zero1", action="store_true", default=False)
+    p.add_argument(
+        "--quantized_grads", action="store_true", default=False
+    )
     return p
 
 

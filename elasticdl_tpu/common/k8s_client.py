@@ -290,10 +290,32 @@ class Client:
             return self._rest.delete_pod(self.namespace, name)
         return self._v1.delete_namespaced_pod(name, self.namespace)
 
-    def get_pod_phase(self, replica_type, replica_index, incarnation=0):
-        name = self.pod_name(replica_type, replica_index, incarnation)
+    def _read_phase(self, name):
         if self._rest is not None:
             pod = self._rest.read_pod(self.namespace, name)
-            return ((pod.get("status") or {}).get("phase"))
+            return (pod.get("status") or {}).get("phase")
         pod = self._v1.read_namespaced_pod(name, self.namespace)
         return pod.status.phase
+
+    def get_pod_phase(self, replica_type, replica_index, incarnation=0):
+        return self._read_phase(
+            self.pod_name(replica_type, replica_index, incarnation)
+        )
+
+    def get_pod_phase_by_name(self, name):
+        """Phase of an arbitrarily-named pod (e.g. the master, which lives
+        outside the replica naming convention); None when the pod does
+        not exist — job monitors poll with this and absence is an answer.
+        Auth/network errors still raise: a monitor silently reading None
+        for 10 minutes on a 403 helps nobody."""
+        try:
+            return self._read_phase(name)
+        except Exception as e:
+            from elasticdl_tpu.common.k8s_rest import K8sApiError
+
+            if isinstance(e, K8sApiError) and e.status == 404:
+                return None
+            status = getattr(e, "status", None)
+            if status == 404:  # official client's ApiException
+                return None
+            raise

@@ -45,6 +45,7 @@ _WORKER_RELAY_ARGS = [
     "model_parallel_size",
     "multi_host",
     "zero1",
+    "quantized_grads",
     "training_data",
     "validation_data",
     "prediction_data",

@@ -54,7 +54,14 @@ def optimizer(lr=0.01):
 
 def feed(records, mode, metadata):
     batch = batch_examples(records)
-    features = batch["image"].astype("float32")
+    image = batch["image"]
+    features = image.astype("float32")
+    if image.dtype == "uint8":
+        # Real IDX-converted records (data/gen/mnist_idx.py) carry raw
+        # 0-255 bytes; normalize so the conv stack sees unit-scale input
+        # (the reference normalized in its feature transform too).
+        # Synthetic float records are already unit-scale.
+        features = features / 255.0
     labels = batch["label"] if mode != Modes.PREDICTION else None
     return features, labels
 

@@ -17,6 +17,34 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+# Intra-process slice of the data dimension, used by multi-host ZeRO-1:
+# optimizer state shards over it while staying replicated across processes,
+# so every process keeps a fully-addressable copy (elastic regroups can
+# snapshot/broadcast it without the dead world's participation).
+ZERO_AXIS = "zero"
+
+
+def process_grouped_devices():
+    """All global devices ordered so each process's devices are contiguous
+    (sorted by (process_index, id)). A flat reshape over this list keeps
+    any trailing mesh axis whose size divides local_device_count entirely
+    inside one process — the invariant multi-host TP/ZeRO-1 rely on for
+    fully-addressable parameters."""
+    return sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+
+
+def batch_axes(mesh: Mesh):
+    """The mesh axes a batch's leading dim shards over: the data axis plus
+    the intra-process zero axis when present (a {data, zero} mesh is pure
+    data parallelism expressed as two factors)."""
+    axes = [a for a in (DATA_AXIS, ZERO_AXIS) if a in mesh.shape]
+    return tuple(axes)
+
+
+def data_parallel_size(mesh: Mesh):
+    import math as _math
+
+    return _math.prod(mesh.shape[a] for a in batch_axes(mesh))
 
 
 def make_mesh(axis_sizes=None, devices=None) -> Mesh:
@@ -87,8 +115,12 @@ def make_mesh(axis_sizes=None, devices=None) -> Mesh:
     return Mesh(chosen.reshape(sizes), axis_names=names)
 
 
-def data_sharding(mesh: Mesh, axis=DATA_AXIS) -> NamedSharding:
-    """Leading-dim batch sharding over the data axis."""
+def data_sharding(mesh: Mesh, axis=None) -> NamedSharding:
+    """Leading-dim batch sharding over the data axis (plus the zero axis
+    when the mesh factors data parallelism into two axes). Pass an explicit
+    axis name or tuple to override."""
+    if axis is None:
+        axis = batch_axes(mesh) or DATA_AXIS
     return NamedSharding(mesh, P(axis))
 
 
@@ -116,7 +148,7 @@ def pad_batch_to_multiple(batch, multiple):
     return padded, real_n
 
 
-def shard_batch(batch, mesh: Mesh, axis=DATA_AXIS):
+def shard_batch(batch, mesh: Mesh, axis=None):
     """Place a host batch onto the mesh, sharded along the data axis.
 
     Single-host: plain device_put. Multi-host (jax.process_count() > 1): each

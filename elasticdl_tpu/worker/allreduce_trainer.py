@@ -27,9 +27,21 @@ rendezvous manager. TPU-first redesign:
   state is left to GSPMD sharding propagation (it mirrors the param layout
   after the first step). If an elastic world change leaves the device count
   indivisible by the model-parallel size, the trainer falls back to pure DP
-  for that epoch rather than failing the job. TP is single-host only
-  (multi-host TP is rejected at construction: cross-process param shards
-  would break the rank-0 state broadcast).
+  for that epoch rather than failing the job.
+
+- Multi-host composition invariant: sharding axes other than "data" NEVER
+  cross process boundaries. In a multi-process world the model axis (TP)
+  and the zero axis (ZeRO-1) are laid out over each process's LOCAL
+  devices (the mesh is built over process-grouped device order), while
+  the data axis spans processes. Consequences, both deliberate:
+  (1) every process always holds a fully-addressable copy of (variables,
+  opt_state), so the elastic regroup machinery — host snapshot +
+  broadcast_one_to_all — is untouched by TP/ZeRO-1, and any SURVIVOR can
+  re-seed a joiner (cross-process shards would die with the process that
+  owned them, which no broadcast can undo); (2) TP collectives ride the
+  dense intra-host ICI rather than DCN, the standard placement for tensor
+  parallelism at multi-host scale. The tradeoff is that ZeRO-1's memory
+  saving is the local chip count, not the global DP degree.
 """
 
 import threading
@@ -79,50 +91,38 @@ class AllReduceTrainer(JaxTrainer):
         model_parallel_size=1,
         param_specs_fn=None,
         zero1=False,
+        quantized_grads=False,
     ):
         super().__init__(model, loss_fn, optimizer_spec, seed=seed)
         self._model_parallel_size = max(1, int(model_parallel_size or 1))
         self._param_specs_fn = param_specs_fn
         # Cross-replica weight-update sharding (ZeRO-1, parallel/zero1.py):
-        # optimizer state shards over the data axis, GSPMD compiles the
-        # update as reduce-scatter -> shard-local math -> all-gather.
-        # Pure-DP meshes only (under TP the opt layout follows the params).
+        # optimizer state shards over the data axis (single process) or the
+        # intra-process "zero" axis (multi-host — see the module docstring's
+        # composition invariant); GSPMD compiles the update as
+        # reduce-scatter -> shard-local math -> all-gather. Pure-DP meshes
+        # only (under TP the opt layout follows the params).
         self._zero1 = bool(zero1)
-        if zero1 and multi_host:
-            # Same failure mode the multi-host TP guard below rejects:
-            # dim-0 sharding over a cross-process data axis makes the
-            # optimizer state non-fully-addressable, so the host snapshot
-            # backing elastic regroups (_state_provider) cannot
-            # device_get it — every world change would silently broadcast
-            # zeros over all training state.
-            raise ValueError(
-                "zero1=True is not supported with multi_host=True: "
-                "optimizer state sharded across processes breaks the "
-                "regroup state snapshot. Use ZeRO-1 within one host "
-                "(single process, multiple chips) or pure DP across "
-                "hosts."
-            )
         if zero1 and self._model_parallel_size > 1:
             logger.warning(
                 "zero1 is ignored when tensor parallelism is active "
                 "(the optimizer layout follows the param layout); "
                 "per-chip optimizer memory will NOT drop"
             )
-        if multi_host and self._model_parallel_size > 1:
-            # Multi-host TP would shard params across processes, making
-            # them non-fully-addressable — the host-side state snapshot
-            # that backs rank-0 broadcast (_state_provider) cannot
-            # device_get such arrays, so every elastic regroup would
-            # silently discard progress. Gathering inside the snapshot is
-            # a collective and _state_provider runs on rank 0's gRPC
-            # thread alone, so it cannot be done there. Refuse loudly
-            # until the broadcast path grows a sharded-pull protocol.
-            raise ValueError(
-                "model_parallel_size > 1 is not supported with "
-                "multi_host=True: params sharded across processes break "
-                "the rank-0 state broadcast. Run TP within one host "
-                "(single process, multiple chips) or use pure DP "
-                "across hosts."
+        # EQuARX-style int8 gradient allreduce (parallel/quantized.py):
+        # the DP step is formulated with shard_map so the data-axis
+        # gradient reduction goes through quantized_pmean (int8 wire both
+        # legs) instead of XLA's f32 collective. On a {data, zero} mesh
+        # only the cross-process data leg quantizes — the intra-host zero
+        # reduction stays exact f32 on ICI, which is precisely the
+        # EQuARX deployment shape (quantize DCN, not ICI). Ignored under
+        # TP (grads there are sharded by layout, not replicated).
+        self._quantized_grads = bool(quantized_grads)
+        if quantized_grads and self._model_parallel_size > 1:
+            logger.warning(
+                "quantized_grads is ignored when tensor parallelism is "
+                "active (TP gradients follow the param layout; there is "
+                "no whole-tree DP allreduce to quantize)"
             )
         self._step_rng_base = jax.random.fold_in(
             jax.random.PRNGKey(seed), 0x5EED
@@ -235,6 +235,7 @@ class AllReduceTrainer(JaxTrainer):
                 epoch=resp.rendezvous_id,
             )
         self._mesh = self._make_world_mesh()
+        logger.info("Mesh axes: %s", dict(self._mesh.shape))
         self._sharded_steps = {}
         self._local_forward = None  # compiled against the torn-down backend
         if self._multi_host and jax.process_count() > 1:
@@ -352,8 +353,17 @@ class AllReduceTrainer(JaxTrainer):
     # ---------- mesh / sharding layout ----------
 
     def _make_world_mesh(self):
+        from elasticdl_tpu.parallel.mesh import (
+            DATA_AXIS,
+            MODEL_AXIS,
+            ZERO_AXIS,
+            process_grouped_devices,
+        )
+
         mp = self._model_parallel_size
         n = len(jax.devices())
+        local_n = jax.local_device_count()
+        multi_proc = jax.process_count() > 1
         if mp > 1 and self._param_specs_fn is None:
             # A model axis without param layouts would just duplicate the
             # same DP computation mp times — half (or worse) of the
@@ -368,6 +378,16 @@ class AllReduceTrainer(JaxTrainer):
                 "model_parallel_size %d does not divide %d devices; "
                 "falling back to pure data parallelism for this world",
                 mp, n,
+            )
+        elif mp > 1 and multi_proc and local_n % mp != 0:
+            # Composition invariant (module docstring): the model axis must
+            # stay inside one process so params remain fully addressable
+            # for regroup snapshots (and TP collectives stay on-host ICI).
+            logger.warning(
+                "model_parallel_size %d does not divide the %d local "
+                "devices of each process; multi-host TP requires an "
+                "intra-process model axis — falling back to pure data "
+                "parallelism for this world", mp, local_n,
             )
         elif mp > 1:
             bad = (
@@ -384,13 +404,28 @@ class AllReduceTrainer(JaxTrainer):
                     "%d (%s); falling back to pure data parallelism",
                     mp, "; ".join(bad[:3]),
                 )
-            else:
-                from elasticdl_tpu.parallel.mesh import (
-                    DATA_AXIS,
-                    MODEL_AXIS,
+            elif multi_proc:
+                # Explicit process-grouped device order: the flat reshape
+                # (data, model) then slices each length-mp model group out
+                # of ONE process's devices (local_n % mp == 0 checked
+                # above). mesh_utils reordering could break that, so the
+                # explicit device list skips it.
+                return make_mesh(
+                    {DATA_AXIS: -1, MODEL_AXIS: mp},
+                    devices=process_grouped_devices(),
                 )
-
+            else:
                 return make_mesh({DATA_AXIS: -1, MODEL_AXIS: mp})
+        if self._zero1 and multi_proc and local_n > 1:
+            # Factor pure DP into (data across processes, zero within):
+            # the batch shards over both axes; optimizer state shards over
+            # "zero" only, staying replicated across processes — saving
+            # local_n x optimizer memory while every process keeps a
+            # fully-addressable copy for elastic regroups.
+            return make_mesh(
+                {DATA_AXIS: jax.process_count(), ZERO_AXIS: local_n},
+                devices=process_grouped_devices(),
+            )
         return make_mesh()
 
     def _spec_violations(self, variables, mp):
@@ -433,15 +468,38 @@ class AllReduceTrainer(JaxTrainer):
 
     def _opt_placement(self, opt_tree):
         """Optimizer-state layout on the current mesh: ZeRO-1 dim-0
-        sharding over the data axis when enabled (pure DP), replicated
-        otherwise (under TP the initial replication is resharded by GSPMD
-        to mirror the param layout after the first step)."""
+        sharding when enabled (pure DP) — over the whole data axis in a
+        single-process world, over the intra-process "zero" axis in a
+        multi-host one — replicated otherwise (under TP the initial
+        replication is resharded by GSPMD to mirror the param layout
+        after the first step)."""
         if self._zero1 and not self._tp_active():
+            from elasticdl_tpu.parallel.mesh import ZERO_AXIS
             from elasticdl_tpu.parallel.zero1 import (
                 weight_update_shardings,
             )
 
-            return weight_update_shardings(opt_tree, self._mesh)
+            if ZERO_AXIS in self._mesh.shape:
+                axis = ZERO_AXIS
+            elif jax.process_count() == 1:
+                axis = "data"
+            else:
+                # Multi-process world whose mesh got no zero axis (one
+                # local device per process): dim-0 sharding over the
+                # cross-process data axis would make the optimizer state
+                # non-fully-addressable and break the regroup snapshot —
+                # the exact failure the composition invariant exists to
+                # prevent. Replicate instead; there is no intra-process
+                # slice to save memory over anyway.
+                logger.warning(
+                    "zero1 has no effect in this world: each process "
+                    "holds one device, so there is no intra-process "
+                    "axis to shard optimizer state over"
+                )
+                return replicated_sharding(self._mesh)
+            return weight_update_shardings(
+                opt_tree, self._mesh, axis=axis
+            )
         return replicated_sharding(self._mesh)
 
     def _tp_active(self):
@@ -500,10 +558,14 @@ class AllReduceTrainer(JaxTrainer):
             # the reference's ragged-last-batch Horovod averaging.
             slice_to = real_n if jax.process_count() == 1 else None
 
-            def step_fn(variables, opt_state, rng, features, labels):
-                return self._step_body(
-                    variables, opt_state, rng, features, labels, slice_to
-                )
+            if self._quantized_grads and not self._tp_active():
+                step_fn = self._quantized_step_fn()
+            else:
+                def step_fn(variables, opt_state, rng, features, labels):
+                    return self._step_body(
+                        variables, opt_state, rng, features, labels,
+                        slice_to,
+                    )
 
             # No buffer donation here (unlike the local trainer): a comm
             # failure mid-step must leave (variables, opt_state) intact for
@@ -529,6 +591,61 @@ class AllReduceTrainer(JaxTrainer):
             )
             self._sharded_steps[key] = step
         return step
+
+    def _quantized_step_fn(self):
+        """DP step with the data-axis gradient reduction quantized to int8
+        (EQuARX-style — see the constructor comment). shard_map computes
+        per-shard grads from the local batch rows, reduces them exactly
+        over any intra-host "zero" axis, then through quantized_pmean
+        over "data"; the optimizer update runs outside on the replicated
+        result (so it composes with ZeRO-1's sharded opt state — GSPMD
+        shards the update math and all-gathers the params). No slice_to:
+        the loss is over the whole padded batch, same semantics as the
+        multi-host path documented in _sharded_step_for."""
+        import optax
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from elasticdl_tpu.parallel.mesh import ZERO_AXIS, batch_axes
+        from elasticdl_tpu.parallel.quantized import quantized_pmean
+
+        axes = batch_axes(self._mesh)
+        mesh = self._mesh
+
+        def shard_fn(params, state, rng, features, labels):
+            # Decorrelate dropout across shards (each shard holds
+            # different rows); fold_in keeps it deterministic.
+            idx = jax.lax.axis_index(axes)
+            rng = jax.random.fold_in(rng, idx)
+            loss, grads, new_state = self._apply_train(
+                params, state, rng, features, labels, None
+            )
+            if ZERO_AXIS in axes:
+                # Intra-host leg stays exact f32 on ICI.
+                grads = jax.lax.pmean(grads, ZERO_AXIS)
+            grads = quantized_pmean(grads, "data")
+            loss = jax.lax.pmean(loss, axes)
+            if new_state:
+                new_state = jax.lax.pmean(new_state, axes)
+            return loss, grads, new_state
+
+        def step_fn(variables, opt_state, rng, features, labels):
+            params = variables["params"]
+            state = {k: v for k, v in variables.items() if k != "params"}
+            loss, grads, new_state = shard_map(
+                shard_fn,
+                mesh=mesh,
+                in_specs=(P(), P(), P(), P(axes), P(axes)),
+                out_specs=(P(), P(), P()),
+                check_vma=False,
+            )(params, state, rng, features, labels)
+            updates, new_opt_state = self._optax.update(
+                grads, opt_state, params
+            )
+            new_params = optax.apply_updates(params, updates)
+            return {"params": new_params, **new_state}, new_opt_state, loss
+
+        return step_fn
 
     # ---------- Trainer interface ----------
 
@@ -591,7 +708,9 @@ class AllReduceTrainer(JaxTrainer):
         return self._run_sharded_step(features, labels)
 
     def _run_sharded_step(self, features, labels):
-        n_data = self._mesh.shape["data"]
+        from elasticdl_tpu.parallel.mesh import data_parallel_size
+
+        n_data = data_parallel_size(self._mesh)
         padded_f, real_n = pad_batch_to_multiple(features, n_data)
         padded_l, _ = pad_batch_to_multiple(labels, n_data)
         padded_n = jax.tree_util.tree_leaves(padded_f)[0].shape[0]

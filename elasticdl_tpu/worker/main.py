@@ -63,6 +63,7 @@ def build_trainer(args, spec, master_client):
             model_parallel_size=args.model_parallel_size,
             param_specs_fn=getattr(spec.module, "param_specs", None),
             zero1=args.zero1,
+            quantized_grads=args.quantized_grads,
         )
     from elasticdl_tpu.worker.trainer import LocalTrainer
 

@@ -276,25 +276,14 @@ def test_tp_falls_back_when_dims_indivisible():
 
 
 def test_tp_guard_rails():
-    """Multi-host TP is rejected loudly (cross-process shards would break
-    rank-0 broadcast); TP without a param_specs hook falls back to DP
-    instead of duplicating compute across a useless model axis."""
-    from elasticdl_tpu.models.transformer import transformer_lm as tlm
-
+    """TP without a param_specs hook falls back to DP instead of
+    duplicating compute across a useless model axis. (Multi-host TP is no
+    longer rejected: the model axis is laid out inside each process —
+    the 2-process drill in test_elasticity_drill.py proves that path.)"""
     with start_master(
         training_shards={"f": (0, 100)}, with_membership=True
     ) as m:
         mc = MasterClient(m["addr"], worker_id=0, worker_host="127.0.0.1")
-        with pytest.raises(ValueError, match="multi_host"):
-            AllReduceTrainer(
-                test_module.custom_model(),
-                test_module.loss,
-                test_module.optimizer(),
-                mc,
-                multi_host=True,
-                model_parallel_size=2,
-                param_specs_fn=tlm.param_specs,
-            )
         # mp=2 but no hook: mesh must stay pure-DP.
         t = AllReduceTrainer(
             test_module.custom_model(),
@@ -383,20 +372,63 @@ def test_zero1_weight_update_sharding_matches_replicated():
             t.close()
 
 
-def test_zero1_multi_host_rejected():
-    """zero1 + multi_host would make the optimizer state
-    non-fully-addressable and break the regroup snapshot (same guard
-    shape as multi-host TP)."""
-    with start_master(
-        training_shards={"f": (0, 100)}, with_membership=True
-    ) as m:
-        mc = MasterClient(m["addr"], worker_id=0, worker_host="127.0.0.1")
-        with pytest.raises(ValueError, match="zero1"):
-            AllReduceTrainer(
-                test_module.custom_model(),
-                test_module.loss,
-                test_module.optimizer(),
-                mc,
-                multi_host=True,
-                zero1=True,
+def test_zero1_multihost_layout_matches_replicated():
+    """The multi-host ZeRO-1 layout — a {data: n_proc, zero: local} mesh
+    with the batch sharded over both axes and optimizer state sharded
+    over "zero" only — must train bit-identically to the replicated
+    baseline, keep every opt leaf fully addressable (the regroup
+    snapshot's requirement), and actually shard over the zero axis.
+    Emulated in one process by forcing the two-axis mesh the trainer
+    builds when jax.process_count() > 1."""
+    import jax
+
+    from elasticdl_tpu.models.transformer import transformer_lm as tlm
+    from elasticdl_tpu.parallel.mesh import DATA_AXIS, ZERO_AXIS, make_mesh
+
+    cfg = tlm.LMConfig(
+        vocab=64, d_model=32, n_heads=4, n_layers=1, max_len=16,
+        activation_dtype="float32",
+    )
+    tokens = (np.arange(16 * 17).reshape(16, 17) * 5) % cfg.vocab
+    f, l = tokens[:, :-1], tokens[:, 1:]
+
+    def run(zero1, force_two_axis):
+        with start_master(
+            training_shards={"f": (0, 100)}, with_membership=True
+        ) as m:
+            mc = MasterClient(
+                m["addr"], worker_id=0, worker_host="127.0.0.1"
             )
+            t = AllReduceTrainer(
+                tlm.custom_model(cfg), tlm.loss, tlm.optimizer(), mc,
+                zero1=zero1, seed=3,
+            )
+            if force_two_axis:
+                t._make_world_mesh = lambda: make_mesh(
+                    {DATA_AXIS: 2, ZERO_AXIS: 4}
+                )
+            try:
+                losses = [
+                    float(t.train_minibatch(f, l)[2]) for _ in range(4)
+                ]
+                opt_state = t._opt_state
+                snapshot = t._state_provider()  # must not be None/raise
+                assert snapshot is not None
+                return losses, opt_state, t._mesh
+            finally:
+                t.close()
+                mc.close()
+
+    base_losses, _, _ = run(zero1=False, force_two_axis=False)
+    z_losses, opt_state, mesh = run(zero1=True, force_two_axis=True)
+    assert base_losses == z_losses
+    assert mesh.shape == {"data": 2, "zero": 4}
+    sharded = 0
+    for leaf in jax.tree_util.tree_leaves(opt_state):
+        if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] % 4 == 0:
+            assert leaf.is_fully_addressable
+            shard = leaf.addressable_shards[0].data
+            # Sharded over zero (4) only — NOT over data * zero (8).
+            assert shard.shape[0] == leaf.shape[0] // 4
+            sharded += 1
+    assert sharded > 0

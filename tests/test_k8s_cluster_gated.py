@@ -63,3 +63,37 @@ def test_watch_stream_reports_events(client):
     while time.time() < deadline and not events:
         time.sleep(1)
     assert events, "no watch events within 120s"
+
+
+def test_live_cluster_smoke_job(tmp_path):
+    """The reference's CI capstone (run_job.sh:33-39 +
+    validate_job_status.py:90): a real `edl train` job submitted to the
+    cluster, pod phases polled to completion. Needs K8S_TESTS_IMAGE to
+    contain this package + model zoo + the training data path."""
+    import json
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    image = os.environ.get("K8S_TESTS_IMAGE", "")
+    data = os.environ.get(
+        "K8S_TESTS_TRAINING_DATA", "/data/mnist_train.edlr"
+    )
+    if not image:
+        pytest.skip("set K8S_TESTS_IMAGE to an elasticdl_tpu image")
+    res = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(repo, "tools", "live_cluster_smoke.py"),
+            "--image", image,
+            "--training_data", data,
+            "--namespace",
+            os.environ.get("K8S_TESTS_NAMESPACE", "default"),
+            "--timeout", "600",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=700,
+    )
+    result = json.loads(res.stdout.strip().splitlines()[-1])
+    assert result["succeeded"], result

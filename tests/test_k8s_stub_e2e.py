@@ -315,3 +315,47 @@ def test_default_rest_api_sources(monkeypatch, tmp_path):
     api = k8s_rest.default_rest_api()
     assert api._scheme == "https" and api._token == "tok-123"
     assert api._headers()["Authorization"] == "Bearer tok-123"
+
+
+def test_live_cluster_smoke_loop_against_stub(api_server):
+    """tools/live_cluster_smoke.py end to end against the stub API server:
+    submit through the real CLI, poll phases, observe Succeeded. (The
+    K8S_TESTS-gated twin in test_k8s_cluster_gated.py runs the identical
+    loop against a real cluster — reference run_job.sh:33-39 +
+    validate_job_status.py:90.)"""
+    import os
+    import sys
+    import threading
+    import time as _time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    from live_cluster_smoke import run_smoke
+
+    job_name = "stubsmoke"
+
+    def complete_master():
+        # Play kubelet: once the CLI's submission lands, walk the master
+        # pod to Succeeded.
+        deadline = _time.time() + 60
+        name = f"elasticdl-{job_name}-master"
+        while _time.time() < deadline:
+            if name in api_server.pods("default"):
+                api_server.set_pod_phase("default", name, "Running")
+                api_server.set_pod_phase("default", name, "Succeeded")
+                return
+            _time.sleep(0.2)
+
+    t = threading.Thread(target=complete_master, daemon=True)
+    t.start()
+    result = run_smoke(
+        image="example.com/edl:dev",
+        training_data="/data/does-not-matter.edlr",
+        model_def="test_module",
+        model_zoo="/zoo",
+        job_name=job_name,
+        timeout=90,
+    )
+    t.join(timeout=10)
+    assert result["succeeded"], result
+    assert result["phases"]["master"] == "Succeeded"

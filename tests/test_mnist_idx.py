@@ -1,0 +1,145 @@
+"""MNIST IDX -> .edlr converter (data/gen/mnist_idx.py): real IDX binary
+parsing, conversion, and a records->train e2e with the zoo MNIST model —
+the reference's image_dataset_gen.py coverage without the network
+(VERDICT r3 #8 retires half of ADR-6)."""
+
+import gzip
+import struct
+
+import numpy as np
+
+from elasticdl_tpu.data.gen.mnist_idx import convert, main, read_idx
+from elasticdl_tpu.data.recordfile import RecordFile
+
+
+def _write_idx_images(path, images, compress=False):
+    """Standard IDX3 ubyte layout: magic 0x00000803, dims, raw bytes."""
+    payload = struct.pack(
+        ">HBBIII", 0, 0x08, 3, images.shape[0], images.shape[1],
+        images.shape[2],
+    ) + images.astype(np.uint8).tobytes()
+    opener = gzip.open if compress else open
+    with opener(path, "wb") as f:
+        f.write(payload)
+
+
+def _write_idx_labels(path, labels, compress=False):
+    payload = struct.pack(
+        ">HBBI", 0, 0x08, 1, labels.shape[0]
+    ) + labels.astype(np.uint8).tobytes()
+    opener = gzip.open if compress else open
+    with opener(path, "wb") as f:
+        f.write(payload)
+
+
+def _make_separable_digits(n, seed=0):
+    """Class-dependent uint8 images a small CNN can actually learn."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, n).astype(np.uint8)
+    templates = rng.integers(0, 255, (10, 28, 28))
+    noise = rng.integers(-20, 20, (n, 28, 28))
+    images = np.clip(templates[labels] + noise, 0, 255).astype(np.uint8)
+    return images, labels
+
+
+def test_read_idx_roundtrip_gz_and_raw(tmp_path):
+    images, labels = _make_separable_digits(32)
+    for compress, suffix in ((False, ""), (True, ".gz")):
+        ip = str(tmp_path / f"imgs{suffix or '.idx'}{suffix}")
+        lp = str(tmp_path / f"lbls{suffix or '.idx'}{suffix}")
+        _write_idx_images(ip, images, compress)
+        _write_idx_labels(lp, labels, compress)
+        assert np.array_equal(read_idx(ip), images)
+        assert np.array_equal(read_idx(lp), labels)
+
+
+def test_convert_writes_decodable_records(tmp_path):
+    images, labels = _make_separable_digits(48)
+    ip, lp = str(tmp_path / "i.idx"), str(tmp_path / "l.idx")
+    _write_idx_images(ip, images)
+    _write_idx_labels(lp, labels)
+    out = str(tmp_path / "mnist.edlr")
+    n = convert(ip, lp, out, limit=40)
+    assert n == 40
+    from elasticdl_tpu.data.example import decode_example
+
+    rf = RecordFile(out)
+    records = [
+        decode_example(rec) for rec in rf.read(0, rf.num_records)
+    ]
+    assert len(records) == 40
+    assert records[0]["image"].dtype == np.uint8
+    assert records[0]["image"].shape == (28, 28)
+    assert np.array_equal(records[3]["image"], images[3])
+    assert int(records[3]["label"]) == int(labels[3])
+
+
+def test_cli_main_and_count_mismatch(tmp_path):
+    images, labels = _make_separable_digits(16)
+    ip, lp = str(tmp_path / "i.idx"), str(tmp_path / "l.idx")
+    _write_idx_images(ip, images)
+    _write_idx_labels(lp, labels[:8])  # mismatched on purpose
+    out = str(tmp_path / "x.edlr")
+    import pytest
+
+    with pytest.raises(ValueError, match="mismatch"):
+        convert(ip, lp, out)
+    _write_idx_labels(lp, labels)
+    assert main(["--images", ip, "--labels", lp, "--output", out]) == 0
+
+
+def test_idx_records_train_end_to_end(tmp_path):
+    """The full ADR-6 slice: IDX file -> converter -> .edlr -> reader ->
+    master/worker -> zoo MNIST CNN, loss drops."""
+    from elasticdl_tpu.common.constants import JobType
+    from elasticdl_tpu.common.model_utils import get_model_spec
+    from elasticdl_tpu.data.reader import create_data_reader
+    from elasticdl_tpu.worker.master_client import MasterClient
+    from elasticdl_tpu.worker.trainer import LocalTrainer
+    from elasticdl_tpu.worker.worker import Worker
+    from test_utils import start_master
+
+    images, labels = _make_separable_digits(128, seed=3)
+    ip, lp = str(tmp_path / "i.idx.gz"), str(tmp_path / "l.idx.gz")
+    _write_idx_images(ip, images, compress=True)
+    _write_idx_labels(lp, labels, compress=True)
+    data = str(tmp_path / "mnist.edlr")
+    convert(ip, lp, data)
+
+    spec = get_model_spec("elasticdl_tpu.models.mnist.mnist_model")
+    reader = create_data_reader(data)
+    trainer = LocalTrainer(
+        spec.build_model(), spec.loss, spec.build_optimizer_spec()
+    )
+    with start_master(
+        training_shards=reader.create_shards(),
+        records_per_task=64,
+        num_epochs=4,
+    ) as m:
+        worker = Worker(
+            0,
+            MasterClient(m["addr"], 0),
+            reader,
+            spec,
+            trainer,
+            minibatch_size=32,
+            job_type=JobType.TRAINING_ONLY,
+        )
+        raw = list(RecordFile(data).read(0, 64))
+        feats, lbls = spec.module.feed(raw, "training", None)
+        # Train-mode losses on a fixed batch: the CNN's BatchNorm running
+        # stats need far more steps than this tiny job to make eval-mode
+        # forwards meaningful, but the training loss must still drop.
+        _, _, loss0 = trainer.train_minibatch(feats, lbls)
+        loss0 = float(loss0)
+        worker.run()
+        assert m["task_d"].finished() and not m["task_d"].job_failed
+        _, _, loss1 = trainer.train_minibatch(feats, lbls)
+        assert float(loss1) < loss0, (loss0, float(loss1))
+
+
+def _records(path):
+    from elasticdl_tpu.data.example import decode_example
+
+    rf = RecordFile(path)
+    return [decode_example(rec) for rec in rf.read(0, rf.num_records)]

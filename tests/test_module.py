@@ -29,6 +29,12 @@ def loss(labels, predictions):
 
 
 def optimizer(lr=0.1):
+    # EDL_TEST_OPT=adam gives the model real (dim-0-shardable) optimizer
+    # state, which the ZeRO-1 drills need — sgd has no moments to shard.
+    import os
+
+    if os.environ.get("EDL_TEST_OPT") == "adam":
+        return optimizers.adam(learning_rate=0.02)
     return optimizers.sgd(learning_rate=lr)
 
 
@@ -36,6 +42,23 @@ def feed(records, mode, metadata):
     batch = batch_examples(records)
     labels = batch["y"] if mode != Modes.PREDICTION else None
     return batch["x"], labels
+
+
+def param_specs(variables):
+    """Tensor-parallel layout hook: Dense kernels shard their input dim
+    over the model axis (row-parallel linear — GSPMD inserts the psum on
+    the contraction), biases replicate. Lets the elasticity drill run a
+    real DP x TP mesh on this toy model."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def spec(path, leaf):
+        names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        if names and names[-1] == "kernel" and leaf.ndim == 2:
+            return P("model", None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, variables)
 
 
 def eval_metrics_fn():

@@ -36,6 +36,30 @@ def _free_port():
     return port
 
 
+def free_coordinator_block(width=16, attempts=64):
+    """A base port whose whole [base, base+width) rotation block binds
+    clean right now. Fixed well-known coordinator ports poison drill
+    reruns: a failed run's orphan can sit in RegisterTask on the old
+    block and absorb the next run's rendezvous."""
+    import random
+
+    for _ in range(attempts):
+        base = random.randrange(20000, 60000 - width)
+        ok = True
+        for p in range(base, base + width):
+            s = socket.socket()
+            try:
+                s.bind(("127.0.0.1", p))
+            except OSError:
+                ok = False
+                break
+            finally:
+                s.close()
+        if ok:
+            return base
+    raise RuntimeError("no free coordinator port block found")
+
+
 def _find_worker_pid(worker_id, master_port, timeout=60):
     """Pid of the worker subprocess (a python -m elasticdl_tpu.worker.main
     child with our master port on its command line)."""
@@ -79,9 +103,13 @@ def run_drill(
 
     port = _free_port()
     env = dict(os.environ)
-    env["PYTHONPATH"] = (
-        f"{REPO}:{model_zoo}:" + env.get("PYTHONPATH", "")
-    )
+    # Full control of the children's import path — do NOT append the
+    # inherited PYTHONPATH: a machine-level sitecustomize on it (e.g. a
+    # TPU-attach hook) pre-imports jax and initializes the backend at
+    # interpreter start, after which the XLA_FLAGS/device-count settings
+    # the drill passes are silently ignored and every worker sees one
+    # device instead of the virtual multi-chip world.
+    env["PYTHONPATH"] = f"{REPO}:{model_zoo}"
     env.update(env_overrides or {})
     train = subprocess.Popen(
         [
@@ -106,6 +134,10 @@ def run_drill(
         text=True,
         env=env,
         cwd=REPO,
+        # Own process group: teardown must reap the master's worker/PS
+        # children too — an orphaned worker blocked in a rendezvous
+        # poisons every later drill that lands on the same ports.
+        start_new_session=True,
     )
     result = {
         "completed": False,
@@ -175,6 +207,13 @@ def run_drill(
         out = train.stdout.read()
         result["relaunched"] = "Relaunching worker 0" in out
         result["recovered_tasks"] = "Recovered" in out
+        # Mesh layouts the workers actually built (lets drills assert a
+        # TP/ZeRO world really formed rather than silently falling back).
+        import re
+
+        result["mesh_axes_seen"] = sorted(
+            set(re.findall(r"Mesh axes: (\{[^}]*\})", out))
+        )
         result["log_tail"] = out[-2000:]
         # Final record count from the log is not available post-shutdown;
         # report the last sampled figure.
@@ -184,6 +223,10 @@ def run_drill(
     finally:
         if train.poll() is None:
             train.kill()
+        try:
+            os.killpg(os.getpgid(train.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
 
 
 def main():

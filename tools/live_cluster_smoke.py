@@ -1,0 +1,156 @@
+"""Live-cluster smoke job: submit a real `edl train` to a Kubernetes
+cluster and poll pod phases to completion.
+
+The reference's CI tier this mirrors: scripts/travis/run_job.sh:33-39
+submits the client job against minikube and scripts/validate_job_status.py
+polls master/worker pod phases until the job succeeds. Here the same loop
+runs against any reachable cluster (kind/minikube/real), gated behind
+K8S_TESTS=true like the rest of tier 3 (tests/test_k8s_cluster_gated.py).
+
+Requirements:
+- kubeconfig or in-cluster credentials reachable by the official client
+  or the stdlib REST transport (EDL_K8S_API_SERVER for `kubectl proxy`);
+- an image containing this package plus the model zoo and training data
+  (K8S_TESTS_IMAGE), and the elasticdl-master RBAC applied
+  (manifests/elasticdl-rbac.yaml);
+- the training data path valid INSIDE the image/volume.
+
+Usage:
+    python tools/live_cluster_smoke.py \
+        --image my-registry/elasticdl-tpu:dev \
+        --training_data /data/mnist.edlr \
+        [--model_def elasticdl_tpu.models.mnist.mnist_model] \
+        [--namespace default] [--timeout 600]
+
+Prints one JSON line: {"succeeded": bool, "phases": {...}, "elapsed_s": N}
+and exits 0 iff the master pod reached Succeeded.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_smoke(
+    image,
+    training_data,
+    model_def="elasticdl_tpu.models.mnist.mnist_model",
+    model_zoo="/",
+    namespace="default",
+    job_name=None,
+    num_workers=1,
+    num_ps=0,
+    timeout=600,
+    extra_args=(),
+):
+    from elasticdl_tpu.common import k8s_client
+
+    job_name = job_name or f"smoke-{int(time.time())}"
+    submit = subprocess.run(
+        [
+            sys.executable, "-m", "elasticdl_tpu.client.main", "train",
+            "--model_zoo", model_zoo,
+            "--model_def", model_def,
+            "--training_data", training_data,
+            "--num_epochs", "1",
+            "--records_per_task", "64",
+            "--minibatch_size", "32",
+            "--num_workers", str(num_workers),
+            "--num_ps", str(num_ps),
+            "--distribution_strategy",
+            "ParameterServerStrategy" if num_ps else "Local",
+            "--instance_backend", "k8s",
+            "--namespace", namespace,
+            "--image_name", image,
+            "--job_name", job_name,
+            *extra_args,
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+    if submit.returncode != 0:
+        return {
+            "succeeded": False,
+            "job_name": job_name,
+            "error": f"submit failed: {submit.stderr[-800:]}",
+        }
+
+    client = k8s_client.Client(namespace, job_name, image)
+    start = time.time()
+    phases = {}
+    master_phase = None
+    # The reference's validate_job_status.py:90 loop: poll every few
+    # seconds; master Succeeded = the job completed end to end (the
+    # master exits nonzero -> pod Failed on any unfinished task).
+    while time.time() - start < timeout:
+        master_phase = client.get_pod_phase_by_name(
+            f"elasticdl-{job_name}-master"
+        )
+        phases["master"] = master_phase
+        for w in range(num_workers):
+            phases[f"worker-{w}"] = client.get_pod_phase_by_name(
+                client.pod_name("worker", w)
+            )
+        for p in range(num_ps):
+            phases[f"ps-{p}"] = client.get_pod_phase_by_name(
+                client.pod_name("ps", p)
+            )
+        if master_phase in ("Succeeded", "Failed"):
+            break
+        time.sleep(3)
+    return {
+        "succeeded": master_phase == "Succeeded",
+        "job_name": job_name,
+        "phases": phases,
+        "elapsed_s": round(time.time() - start, 1),
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("live_cluster_smoke")
+    p.add_argument(
+        "--image",
+        default=os.environ.get("K8S_TESTS_IMAGE", ""),
+        help="image with elasticdl_tpu + zoo + data baked/mounted",
+    )
+    p.add_argument("--training_data", required=True)
+    p.add_argument(
+        "--model_def", default="elasticdl_tpu.models.mnist.mnist_model"
+    )
+    p.add_argument("--model_zoo", default="/")
+    p.add_argument(
+        "--namespace",
+        default=os.environ.get("K8S_TESTS_NAMESPACE", "default"),
+    )
+    p.add_argument("--job_name", default=None)
+    p.add_argument("--num_workers", type=int, default=1)
+    p.add_argument("--num_ps", type=int, default=0)
+    p.add_argument("--timeout", type=int, default=600)
+    args, extra = p.parse_known_args(argv)
+    if not args.image:
+        p.error("--image (or K8S_TESTS_IMAGE) is required")
+    result = run_smoke(
+        args.image,
+        args.training_data,
+        model_def=args.model_def,
+        model_zoo=args.model_zoo,
+        namespace=args.namespace,
+        job_name=args.job_name,
+        num_workers=args.num_workers,
+        num_ps=args.num_ps,
+        timeout=args.timeout,
+        extra_args=tuple(extra),
+    )
+    print(json.dumps(result))
+    return 0 if result.get("succeeded") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
