@@ -302,6 +302,29 @@ class Client:
             self.pod_name(replica_type, replica_index, incarnation)
         )
 
+    def list_job_pod_phases(self):
+        """{pod_name: phase} for every pod labeled with this job — covers
+        incarnation-suffixed relaunches that fixed-name polling misses
+        (monitors report what actually exists, not what was first
+        launched)."""
+        selector = f"{ELASTICDL_JOB_KEY}={self.job_name}"
+        phases = {}
+        if self._rest is not None:
+            listing = self._rest.list_pods(self.namespace, selector)
+            for item in listing.get("items", []):
+                name = (item.get("metadata") or {}).get("name")
+                if name:
+                    phases[name] = (item.get("status") or {}).get("phase")
+            return phases
+        pods = self._v1.list_namespaced_pod(
+            self.namespace, label_selector=selector
+        )
+        for pod in pods.items:
+            phases[pod.metadata.name] = (
+                pod.status.phase if pod.status else None
+            )
+        return phases
+
     def get_pod_phase_by_name(self, name):
         """Phase of an arbitrarily-named pod (e.g. the master, which lives
         outside the replica naming convention); None when the pod does
@@ -311,11 +334,8 @@ class Client:
         try:
             return self._read_phase(name)
         except Exception as e:
-            from elasticdl_tpu.common.k8s_rest import K8sApiError
-
-            if isinstance(e, K8sApiError) and e.status == 404:
-                return None
-            status = getattr(e, "status", None)
-            if status == 404:  # official client's ApiException
+            # Both transports carry the HTTP status as .status
+            # (k8s_rest.K8sApiError and the official ApiException).
+            if getattr(e, "status", None) == 404:
                 return None
             raise

@@ -293,3 +293,64 @@ def test_quantized_step_hlo_wire_bytes_reduction():
     # per-block scales and the loss sync keep the whole-program ratio a
     # bit above 1/4.
     assert quant_b < 0.35 * exact_b, (quant_b, exact_b)
+
+
+def test_quantized_grads_on_multihost_zero1_mesh():
+    """The advertised composition: a {data: 2, zero: 4} mesh (multi-host
+    ZeRO-1 layout) with --quantized_grads — grads reduce exactly over the
+    intra-host zero axis and through int8 over the cross-process data
+    axis, while the optimizer state stays zero-sharded. Losses must track
+    the exact-f32 two-axis trainer within quantization noise."""
+    import tests.test_module as test_module
+    from elasticdl_tpu.parallel.mesh import DATA_AXIS, ZERO_AXIS, make_mesh
+    from elasticdl_tpu.worker.allreduce_trainer import AllReduceTrainer
+    from elasticdl_tpu.worker.master_client import MasterClient
+    from tests.test_utils import start_master
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, test_module.FEATURE_DIM)).astype(np.float32)
+    y = (x @ test_module.TRUE_W + test_module.TRUE_B).astype(np.float32)
+
+    def run(quantized):
+        import os
+
+        os.environ["EDL_TEST_OPT"] = "adam"  # real dim-0 moments to shard
+        try:
+            with start_master(
+                training_shards={"f": (0, 100)}, with_membership=True
+            ) as m:
+                mc = MasterClient(
+                    m["addr"], worker_id=0, worker_host="127.0.0.1"
+                )
+                t = AllReduceTrainer(
+                    test_module.custom_model(),
+                    test_module.loss,
+                    test_module.optimizer(),
+                    mc,
+                    seed=7,
+                    zero1=True,
+                    quantized_grads=quantized,
+                )
+                t._make_world_mesh = lambda: make_mesh(
+                    {DATA_AXIS: 2, ZERO_AXIS: 4}
+                )
+                try:
+                    losses = [
+                        float(jax.block_until_ready(
+                            t.train_minibatch(x, y)[2]
+                        ))
+                        for _ in range(5)
+                    ]
+                    return losses, t._mesh
+                finally:
+                    t.close()
+                    mc.close()
+        finally:
+            os.environ.pop("EDL_TEST_OPT", None)
+
+    exact, mesh_e = run(False)
+    quant, mesh_q = run(True)
+    assert mesh_e.shape == mesh_q.shape == {"data": 2, "zero": 4}
+    assert quant[-1] < quant[0]  # still learning
+    for a, b in zip(exact, quant):
+        assert b == pytest.approx(a, rel=0.15), (exact, quant)

@@ -43,8 +43,11 @@ def free_coordinator_block(width=16, attempts=64):
     block and absorb the next run's rendezvous."""
     import random
 
+    # Stay BELOW the kernel ephemeral range (32768+): _free_port draws the
+    # master port from it, and a master port landing inside the rotation
+    # block trips validate_args' overlap rejection.
     for _ in range(attempts):
-        base = random.randrange(20000, 60000 - width)
+        base = random.randrange(20000, 32700 - width)
         ok = True
         for p in range(base, base + width):
             s = socket.socket()

@@ -93,15 +93,9 @@ def run_smoke(
         master_phase = client.get_pod_phase_by_name(
             f"elasticdl-{job_name}-master"
         )
-        phases["master"] = master_phase
-        for w in range(num_workers):
-            phases[f"worker-{w}"] = client.get_pod_phase_by_name(
-                client.pod_name("worker", w)
-            )
-        for p in range(num_ps):
-            phases[f"ps-{p}"] = client.get_pod_phase_by_name(
-                client.pod_name("ps", p)
-            )
+        # Label-based listing covers incarnation-suffixed relaunches the
+        # original fixed replica names would miss.
+        phases = {"master": master_phase, **client.list_job_pod_phases()}
         if master_phase in ("Succeeded", "Failed"):
             break
         time.sleep(3)
