@@ -1,0 +1,221 @@
+"""ODPS/MaxCompute table reader.
+
+Reference counterpart: /root/reference/elasticdl/python/data/reader/
+odps_reader.py:26-251 and data/odps_io.py:71-407 (table-tunnel download
+sessions, a parallel page-fetch pool, bounded retries, shard creation from
+the table's row count). This rebuild keeps that orchestration — shard
+creation, ordered parallel page prefetch, per-page retry with backoff —
+as plain tested Python, and gates only the vendor SDK: the reader talks to
+any client exposing the narrow pyodps surface it needs
+(`get_table(name).open_reader(partition=...)` -> object with `.count` and
+`.read(start=, count=)` yielding records with `.values`). In production
+that client is `odps.ODPS(...)` (pyodps); in this air-gapped repo the unit
+tests inject a fake, which is exactly how the k8s layer covers its live
+paths against a stub API server.
+
+Origin URI (create_data_reader): odps://<project>/tables/<table>[/<part>]
+with credentials from the environment (ODPS_ACCESS_ID, ODPS_ACCESS_KEY,
+ODPS_ENDPOINT — the reference's MaxComputeConfig env contract).
+"""
+
+import concurrent.futures
+import os
+import time
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.data.reader import AbstractDataReader, Metadata
+
+logger = get_logger("data.odps_reader")
+
+DEFAULT_PAGE_RECORDS = 4096
+DEFAULT_MAX_RETRIES = 3
+
+
+def _default_client(project, access_id, access_key, endpoint):
+    try:
+        from odps import ODPS  # pyodps, not baked into this image
+    except ImportError as e:
+        raise ImportError(
+            "ODPS reading needs the pyodps package (`pip install pyodps`) "
+            "or an injected client object"
+        ) from e
+    return ODPS(access_id, access_key, project=project, endpoint=endpoint)
+
+
+class OdpsReader(AbstractDataReader):
+    """Reads one ODPS table (optionally one partition) as record tuples.
+
+    Records are yielded in table order as lists of column values — the
+    same shape CSVDataReader yields — with column names in `metadata`,
+    so a model's `feed` is reader-agnostic.
+    """
+
+    def __init__(
+        self,
+        project=None,
+        access_id=None,
+        access_key=None,
+        endpoint=None,
+        table=None,
+        partition=None,
+        columns=None,
+        num_parallel=4,
+        page_records=DEFAULT_PAGE_RECORDS,
+        max_retries=DEFAULT_MAX_RETRIES,
+        retry_base_seconds=0.5,
+        client=None,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        if not table:
+            raise ValueError("OdpsReader requires a table name")
+        self._project = project
+        self._table_name = table
+        self._partition = partition or None
+        self._columns = list(columns) if columns else None
+        self._num_parallel = max(1, int(num_parallel))
+        self._page_records = max(1, int(page_records))
+        self._max_retries = max(1, int(max_retries))
+        self._retry_base_seconds = retry_base_seconds
+        self._client = client or _default_client(
+            project, access_id, access_key, endpoint
+        )
+        self._metadata = None
+
+    # ---------- shard creation (master side) ----------
+
+    def _open_reader(self):
+        table = self._client.get_table(self._table_name)
+        if self._partition:
+            return table.open_reader(partition=self._partition)
+        return table.open_reader()
+
+    def create_shards(self):
+        """One logical shard spanning the table/partition; the master's
+        task dispatcher cuts it into records_per_task ranges exactly as
+        it does for record files (the reference pre-chunked here AND in
+        the dispatcher; one authority is enough)."""
+        count = self._retrying(
+            lambda: int(self._open_reader().count), "row count"
+        )
+        name = self._table_name + (
+            f"/{self._partition}" if self._partition else ""
+        )
+        return {name: (0, count)}
+
+    # ---------- record reading (worker side) ----------
+
+    @property
+    def metadata(self):
+        if self._metadata is None:
+            columns = self._columns
+            if columns is None:
+                try:
+                    columns = self._retrying(
+                        lambda: [
+                            c.name
+                            for c in self._client.get_table(
+                                self._table_name
+                            ).schema.columns
+                        ],
+                        "schema",
+                    )
+                except Exception:
+                    # Schema introspection is best-effort (a client may
+                    # not expose it at all) — but do NOT cache the empty
+                    # answer: a transient failure here would otherwise
+                    # poison every later feed that maps columns by name.
+                    logger.warning(
+                        "ODPS schema introspection failed; column names "
+                        "unavailable this time", exc_info=True,
+                    )
+                    return Metadata(column_names=[])
+            self._metadata = Metadata(column_names=columns)
+        return self._metadata
+
+    def read_records(self, task):
+        """Yield the task's [start, end) rows in order. Pages of
+        `page_records` rows are fetched by a small thread pool with a
+        bounded look-ahead (the reference's parallel tunnel downloads,
+        odps_io.py:214-301), each page independently retried."""
+        start, end = int(task.start), int(task.end)
+        if end <= start:
+            return
+        pages = [
+            (s, min(self._page_records, end - s))
+            for s in range(start, end, self._page_records)
+        ]
+        if len(pages) == 1 or self._num_parallel == 1:
+            for s, n in pages:
+                yield from self._read_page(s, n)
+            return
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=self._num_parallel
+        ) as pool:
+            # Ordered delivery with bounded look-ahead: keep up to
+            # num_parallel pages in flight, always yielding the oldest.
+            futures = {}
+            next_submit = 0
+            for next_yield in range(len(pages)):
+                while (
+                    next_submit < len(pages)
+                    and next_submit - next_yield < self._num_parallel
+                ):
+                    futures[next_submit] = pool.submit(
+                        self._read_page, *pages[next_submit]
+                    )
+                    next_submit += 1
+                yield from futures.pop(next_yield).result()
+
+    def _read_page(self, start, count):
+        def fetch():
+            # A fresh download session per attempt: expired/broken tunnel
+            # sessions are the common ODPS failure mode.
+            reader = self._open_reader()
+            rows = []
+            for record in reader.read(start=start, count=count):
+                values = getattr(record, "values", record)
+                rows.append(list(values))
+            if len(rows) != count:
+                raise IOError(
+                    f"short page at {start}: got {len(rows)}/{count}"
+                )
+            return rows
+
+        return self._retrying(fetch, f"page@{start}")
+
+    def _retrying(self, fn, what):
+        """Run fn() up to max_retries times with exponential backoff."""
+        for attempt in range(self._max_retries):
+            try:
+                return fn()
+            except Exception:
+                if attempt == self._max_retries - 1:
+                    raise
+                delay = self._retry_base_seconds * (2 ** attempt)
+                logger.warning(
+                    "ODPS %s failed (attempt %d/%d); retrying in %.1fs",
+                    what, attempt + 1, self._max_retries, delay,
+                    exc_info=True,
+                )
+                time.sleep(delay)
+
+
+def parse_odps_origin(origin):
+    """odps://<project>/tables/<table>[/<partition>] -> kwargs dict with
+    credentials resolved from the environment."""
+    rest = origin[len("odps://"):]
+    parts = rest.split("/")
+    if len(parts) < 3 or parts[1] != "tables" or not parts[2]:
+        raise ValueError(
+            f"bad ODPS origin {origin!r}; expected "
+            "odps://<project>/tables/<table>[/<partition>]"
+        )
+    return {
+        "project": parts[0],
+        "table": parts[2],
+        "partition": "/".join(parts[3:]) or None,
+        "access_id": os.environ.get("ODPS_ACCESS_ID"),
+        "access_key": os.environ.get("ODPS_ACCESS_KEY"),
+        "endpoint": os.environ.get("ODPS_ENDPOINT"),
+    }

@@ -178,6 +178,13 @@ def create_data_reader(data_origin, records_per_task=None, **kwargs):
         return data_origin
     if isinstance(data_origin, (list, tuple)):
         return InMemoryReader(data_origin, **kwargs)
+    if isinstance(data_origin, str) and data_origin.startswith("odps://"):
+        from elasticdl_tpu.data.odps_reader import (
+            OdpsReader,
+            parse_odps_origin,
+        )
+
+        return OdpsReader(**{**parse_odps_origin(data_origin), **kwargs})
     if os.path.isdir(data_origin):
         return RecordFileReader(data_origin, **kwargs)
     if data_origin.endswith(".csv"):

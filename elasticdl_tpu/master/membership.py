@@ -27,6 +27,7 @@ class MembershipManager:
         self._id_to_host = {}  # worker_id -> registered host
         self._group_id = 0
         self._coordinator_port = coordinator_port
+        self._arrivals = {}  # epoch -> set of hosts at the join gate
 
     def set_worker_hosts(self, hosts):
         """Replace the alive-host set (called by the instance manager on pod
@@ -114,6 +115,24 @@ class MembershipManager:
                 coordinator,
                 port,
             )
+
+    def arrive(self, host, epoch):
+        """Two-phase join gate: record that `host` is about to enter the
+        jax.distributed rendezvous for membership epoch `epoch`. Returns
+        True once EVERY current member has arrived for the CURRENT epoch —
+        the go signal that makes all members call initialize together,
+        instead of each blocking at its own (possibly stale) epoch's
+        rotated port until the coordination client's fatal deadline.
+        Arrivals for superseded epochs are discarded (the caller re-polls
+        get_comm_rank and re-arrives at the new epoch)."""
+        with self._lock:
+            if epoch != self._group_id or host not in self._hosts:
+                return False
+            self._arrivals.setdefault(epoch, set()).add(host)
+            # Prune superseded epochs' arrival sets.
+            for stale in [e for e in self._arrivals if e != epoch]:
+                del self._arrivals[stale]
+            return self._arrivals[epoch] >= set(self._hosts)
 
     @property
     def group_id(self):

@@ -112,12 +112,18 @@ class MasterServicer:
             coordinator,
             coordinator_port,
         ) = self._membership.get_comm_rank(request.worker_host)
+        world_ready = False
+        if request.ready_epoch_plus_one > 0:
+            world_ready = self._membership.arrive(
+                request.worker_host, request.ready_epoch_plus_one - 1
+            )
         return pb.GetCommRankResponse(
             rank_id=rank,
             world_size=world,
             rendezvous_id=group_id,
             coordinator_addr=coordinator,
             rendezvous_port=coordinator_port,
+            world_ready=world_ready,
         )
 
     def lease_steps(self, request, context):

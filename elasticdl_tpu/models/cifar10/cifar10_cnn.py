@@ -57,7 +57,12 @@ def optimizer(lr=0.001):
 
 def feed(records, mode, metadata):
     batch = batch_examples(records)
-    features = batch["image"].astype("float32")
+    image = batch["image"]
+    features = image.astype("float32")
+    if image.dtype == "uint8":
+        # Real pickle-converted records (data/gen/cifar10_pickle.py)
+        # carry raw 0-255 bytes; synthetic float records are unit-scale.
+        features = features / 255.0
     labels = batch["label"] if mode != Modes.PREDICTION else None
     return features, labels
 

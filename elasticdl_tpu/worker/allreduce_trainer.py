@@ -221,6 +221,14 @@ class AllReduceTrainer(JaxTrainer):
             resp.rank_id,
             resp.world_size,
         )
+        if self._multi_host and resp.world_size > 1:
+            # Two-phase join: wait at the master's gate until EVERY rank
+            # of this epoch is about to initialize, so nobody blocks at a
+            # stale epoch's coordination port while a peer is still busy
+            # (the missed-rendezvous churn that killed workers with fatal
+            # RegisterTask deadlines). If membership moves while waiting,
+            # follow it to the new epoch.
+            resp = self._await_join_gate(resp)
         self._rank = resp.rank_id
         self._world_size = resp.world_size
         # Snapshot to host BEFORE any distributed teardown: device arrays of
@@ -274,6 +282,54 @@ class AllReduceTrainer(JaxTrainer):
                 self._variables = None
                 self._opt_state = None
         self._group_id = resp.rendezvous_id
+
+    def _await_join_gate(self, resp, timeout=90.0, poll_seconds=0.25):
+        """Poll the master's join gate until the whole world of
+        resp.rendezvous_id has arrived (world_ready), following any epoch
+        bump to the newest world. Falls through with a warning after
+        `timeout` (e.g. a master predating the gate always answers
+        world_ready=False) — the jax.distributed initialization timeout
+        then remains the backstop, as before the gate existed."""
+        deadline = time.time() + timeout
+        last_liveness = 0.0
+        while time.time() < deadline:
+            # The gate can outlast the master's silent-worker watchdog
+            # window; an actively-polling worker must not look dead
+            # (re-register with the same host is a membership no-op).
+            if time.time() - last_liveness > 5.0:
+                self._mc.report_liveness()
+                last_liveness = time.time()
+            gated = self._mc.get_comm_rank(
+                ready_epoch=resp.rendezvous_id
+            )
+            if gated.rendezvous_id != resp.rendezvous_id:
+                if gated.rank_id < 0:
+                    # Dropped from the group mid-gate (e.g. liveness
+                    # timeout); announce and rejoin.
+                    self._mc.report_liveness()
+                    continue
+                logger.info(
+                    "Membership moved at the join gate: epoch %d -> %d "
+                    "(rank %d of %d)",
+                    resp.rendezvous_id,
+                    gated.rendezvous_id,
+                    gated.rank_id,
+                    gated.world_size,
+                )
+                resp = gated
+                if resp.world_size <= 1:
+                    return resp
+                continue
+            if gated.world_ready:
+                return resp
+            time.sleep(poll_seconds)
+        logger.warning(
+            "Join gate for epoch %d did not fill within %.0fs; "
+            "proceeding to the rendezvous anyway",
+            resp.rendezvous_id,
+            timeout,
+        )
+        return resp
 
     def _sync_state_over_world(self, host_state):
         """Collective state broadcast from (new-world) rank 0: the TPU-first

@@ -61,9 +61,17 @@ class MasterClient:
             pb.ReportVersionRequest(model_version=model_version)
         )
 
-    def get_comm_rank(self):
+    def get_comm_rank(self, ready_epoch=None):
+        """ready_epoch: declare this worker at the join gate for that
+        membership epoch (see proto GetCommRankRequest); the response's
+        world_ready says whether the whole world has arrived."""
         return self._stub.get_comm_rank(
-            pb.GetCommRankRequest(worker_host=self._worker_host)
+            pb.GetCommRankRequest(
+                worker_host=self._worker_host,
+                ready_epoch_plus_one=(
+                    0 if ready_epoch is None else ready_epoch + 1
+                ),
+            )
         )
 
     def lease_steps(self, batch_size):

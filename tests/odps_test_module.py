@@ -1,0 +1,34 @@
+"""Model spec for the ODPS-reader e2e: consumes raw row tuples
+([x0, x1, y] lists, the shape OdpsReader/CSVDataReader yield)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.common.model_utils import Modes
+from elasticdl_tpu.ops import optimizers
+
+
+class LinearModel(nn.Module):
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        return nn.Dense(1)(x)
+
+
+def custom_model():
+    return LinearModel()
+
+
+def loss(labels, predictions):
+    return jnp.mean((predictions.reshape(-1) - labels.reshape(-1)) ** 2)
+
+
+def optimizer(lr=0.1):
+    return optimizers.sgd(learning_rate=lr)
+
+
+def feed(records, mode, metadata):
+    arr = np.asarray(records, dtype=np.float32)
+    features = arr[:, :2]
+    labels = arr[:, 2] if mode != Modes.PREDICTION else None
+    return features, labels

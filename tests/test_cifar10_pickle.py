@@ -1,0 +1,94 @@
+"""CIFAR-10 pickle converter (data/gen/cifar10_pickle.py): real batch
+format (pickled channel-major uint8 rows, plus the tar.gz packaging),
+NHWC conversion, and decodable records."""
+
+import pickle
+import tarfile
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.data.example import decode_example
+from elasticdl_tpu.data.gen.cifar10_pickle import (
+    convert,
+    main,
+    read_batch_file,
+    read_tar,
+)
+from elasticdl_tpu.data.recordfile import RecordFile
+
+
+def _make_batch(n, seed=0):
+    rng = np.random.default_rng(seed)
+    images_nhwc = rng.integers(0, 255, (n, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, n).astype(np.int64)
+    data = images_nhwc.transpose(0, 3, 1, 2).reshape(n, 3072)
+    return images_nhwc, labels, {
+        b"data": data,
+        b"labels": labels.tolist(),
+    }
+
+
+def test_batch_file_roundtrip(tmp_path):
+    images, labels, batch = _make_batch(20)
+    path = str(tmp_path / "data_batch_1")
+    with open(path, "wb") as f:
+        pickle.dump(batch, f)
+    got_images, got_labels = read_batch_file(path)
+    assert np.array_equal(got_images, images)  # channel-major -> NHWC
+    assert np.array_equal(got_labels, labels)
+
+
+def test_tar_train_and_test_splits(tmp_path):
+    tar_path = str(tmp_path / "cifar-10-python.tar.gz")
+    per_batch = 8
+    all_imgs, all_lbls = [], []
+    with tarfile.open(tar_path, "w:gz") as tar:
+        for i, name in enumerate(
+            [f"data_batch_{j}" for j in range(1, 6)] + ["test_batch"]
+        ):
+            images, labels, batch = _make_batch(per_batch, seed=i)
+            member = str(tmp_path / name)
+            with open(member, "wb") as f:
+                pickle.dump(batch, f)
+            tar.add(member, arcname=f"cifar-10-batches-py/{name}")
+            if name != "test_batch":
+                all_imgs.append(images)
+                all_lbls.append(labels)
+    images, labels = read_tar(tar_path, "train")
+    assert images.shape == (5 * per_batch, 32, 32, 3)
+    assert np.array_equal(images, np.concatenate(all_imgs))
+    assert np.array_equal(labels, np.concatenate(all_lbls))
+    test_images, _ = read_tar(tar_path, "test")
+    assert test_images.shape == (per_batch, 32, 32, 3)
+    # A tar missing expected members fails loudly.
+    partial = str(tmp_path / "partial.tar.gz")
+    with tarfile.open(partial, "w:gz") as tar:
+        member = str(tmp_path / "data_batch_1")
+        tar.add(member, arcname="data_batch_1")
+    with pytest.raises(ValueError, match="not found"):
+        read_tar(partial, "train")
+
+
+def test_convert_and_cli(tmp_path):
+    images, labels, batch = _make_batch(24)
+    path = str(tmp_path / "data_batch_1")
+    with open(path, "wb") as f:
+        pickle.dump(batch, f)
+    out = str(tmp_path / "cifar.edlr")
+    assert main(["--batches", path, "--output", out, "--limit", "20"]) == 0
+    rf = RecordFile(out)
+    records = [decode_example(r) for r in rf.read(0, rf.num_records)]
+    assert len(records) == 20
+    assert records[5]["image"].shape == (32, 32, 3)
+    assert records[5]["image"].dtype == np.uint8
+    assert np.array_equal(records[5]["image"], images[5])
+    assert int(records[5]["label"]) == int(labels[5])
+    # The zoo model's feed consumes these records directly (normalized).
+    from elasticdl_tpu.models.cifar10 import cifar10_cnn
+
+    feats, lbls = cifar10_cnn.feed(
+        list(rf.read(0, 8)), "training", None
+    )
+    assert feats.dtype == np.float32 and feats.max() <= 1.0
+    assert lbls.shape == (8,)

@@ -56,3 +56,28 @@ def test_worker_host_swap_reassigns():
     # The dead host is unknown now.
     rank, world, *_ = m.get_comm_rank("a:1")
     assert rank == -1 or "a:1" not in m.worker_hosts
+
+
+def test_join_gate_arrivals():
+    """Two-phase join gate (round 4): world_ready only when every member
+    of the CURRENT epoch has arrived; arrivals at stale epochs are
+    discarded; membership changes reset the gate."""
+    m = MembershipManager()
+    m.register(0, "a:1")
+    m.register(1, "b:1")
+    epoch = m.group_id
+    assert m.arrive("a:1", epoch) is False  # b not arrived yet
+    assert m.arrive("b:1", epoch) is True   # full house
+    assert m.arrive("a:1", epoch) is True   # idempotent re-poll
+    # Stale epoch: never ready.
+    assert m.arrive("a:1", epoch - 1) is False
+    # Unknown host: not counted.
+    assert m.arrive("nobody:9", epoch) is False
+    # Membership change bumps the epoch and empties the gate.
+    m.register(2, "c:1")
+    epoch2 = m.group_id
+    assert epoch2 != epoch
+    assert m.arrive("a:1", epoch) is False      # old epoch dead
+    assert m.arrive("a:1", epoch2) is False
+    assert m.arrive("b:1", epoch2) is False
+    assert m.arrive("c:1", epoch2) is True
