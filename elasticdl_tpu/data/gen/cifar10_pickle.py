@@ -35,12 +35,21 @@ def read_batch_file(path):
 def _decode_batch(fileobj):
     batch = pickle.load(fileobj, encoding="bytes")
     data = np.asarray(batch[b"data"], dtype=np.uint8)
-    labels = np.asarray(
-        batch.get(b"labels", batch.get(b"fine_labels")), dtype=np.int64
-    )
+    if b"labels" not in batch:
+        raise ValueError(
+            "not a CIFAR-10 batch: no b'labels' key (CIFAR-100 files "
+            "carry b'fine_labels' and 100 classes — this converter is "
+            "CIFAR-10 only)"
+        )
+    labels = np.asarray(batch[b"labels"], dtype=np.int64)
     if data.ndim != 2 or data.shape[1] != 3072:
         raise ValueError(
             f"not a CIFAR-10 batch: data shape {data.shape}"
+        )
+    if labels.size and (labels.min() < 0 or labels.max() > 9):
+        raise ValueError(
+            f"not a CIFAR-10 batch: labels outside [0, 9] "
+            f"(min {labels.min()}, max {labels.max()})"
         )
     # Rows are channel-major [3, 32, 32]; the zoo model is NHWC.
     images = data.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)

@@ -124,7 +124,18 @@ class MembershipManager:
         instead of each blocking at its own (possibly stale) epoch's
         rotated port until the coordination client's fatal deadline.
         Arrivals for superseded epochs are discarded (the caller re-polls
-        get_comm_rank and re-arrives at the new epoch)."""
+        get_comm_rank and re-arrives at the new epoch).
+
+        A filled epoch's set deliberately persists until the epoch moves:
+        every member polls until it OBSERVES ready=True, so clearing on
+        first observation would deadlock the rest. The lone-rejoiner
+        corner this leaves open (a worker restarting with a bitwise
+        IDENTICAL host string inside an unchanged epoch gets an instant
+        green light) is unreachable in practice — host strings embed the
+        broadcast server's ephemeral port, so a restarted process always
+        registers a new host and bumps the epoch — and if it ever did
+        happen, that rendezvous can never complete anyway (survivors'
+        ensure_world no-ops at an unchanged epoch), gate or no gate."""
         with self._lock:
             if epoch != self._group_id or host not in self._hosts:
                 return False

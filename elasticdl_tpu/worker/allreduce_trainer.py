@@ -305,8 +305,10 @@ class AllReduceTrainer(JaxTrainer):
             if gated.rendezvous_id != resp.rendezvous_id:
                 if gated.rank_id < 0:
                     # Dropped from the group mid-gate (e.g. liveness
-                    # timeout); announce and rejoin.
+                    # timeout); announce and rejoin — paced, not a hot
+                    # loop against the master while it churns.
                     self._mc.report_liveness()
+                    time.sleep(poll_seconds)
                     continue
                 logger.info(
                     "Membership moved at the join gate: epoch %d -> %d "

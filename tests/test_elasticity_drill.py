@@ -97,6 +97,15 @@ def test_kill_worker_mid_job_drill(tmp_path, strategy, num_ps):
             {"EDL_TEST_OPT": "adam"},
             "'zero': 4",
         ),
+        # DP with int8-quantized gradient reduction across processes:
+        # the EQuARX wire format under real elasticity — training must
+        # converge through the SIGKILL regroup with quantized collectives.
+        (
+            "dp_quantized",
+            ("--quantized_grads",),
+            {},
+            "'data': 8",
+        ),
     ],
 )
 def test_kill_worker_mid_job_multihost_lease_drill(
