@@ -152,8 +152,12 @@ def bench_deepfm_criteo(batch_size=32768, steps=30, warmup=5):
     }
 
 
-def bench_deepfm_ps(batch_size=16384, steps=6, warmup=2, num_ps=2,
+def bench_deepfm_ps(batch_size=16384, steps=6, warmup=4, num_ps=2,
                     repeats=2):
+    # warmup=4 covers each of the 4 distinct id batches once, so measured
+    # steps hit warm PS rows (the r4 run-to-run spread — 3.6k vs 7.2k on
+    # identical configs — was cold-row lazy init landing inside the timed
+    # window of whichever run compiled first).
     # Batch 16384, not smaller: the push-thread overlap needs enough
     # per-step RPC work to amortize its contention with prefetch on a
     # single-core host (measured 1.22x at 16384 but 0.92x at 8192).
