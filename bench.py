@@ -249,6 +249,11 @@ def bench_deepfm_ps(batch_size=16384, steps=6, warmup=4, num_ps=2,
         ("serialized", False, "float32"),
         ("serialized_bf16_wire", False, "bfloat16"),
         ("pipelined", True, "float32"),
+        # Measured negative result (round 4): background pushes AND bf16
+        # conversions contend on a single-core host, so this combo runs
+        # BELOW plain pipelined (7.0k vs 9.1k ex/s) — kept measured so a
+        # multi-core PS deployment can see when the levers start stacking.
+        ("pipelined_bf16_wire", True, "bfloat16"),
     )
     out = {"best_of_n": repeats, "loadavg_start": os.getloadavg()[0]}
     for name, pipelined, wire in configs:
