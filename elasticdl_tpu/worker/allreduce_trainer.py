@@ -54,9 +54,15 @@ import numpy as np
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.parallel import broadcast, distributed
 from elasticdl_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    ZERO_AXIS,
+    batch_axes,
+    data_parallel_size,
     data_sharding,
     make_mesh,
     pad_batch_to_multiple,
+    process_grouped_devices,
     replicated_sharding,
     shard_batch,
 )
@@ -411,13 +417,6 @@ class AllReduceTrainer(JaxTrainer):
     # ---------- mesh / sharding layout ----------
 
     def _make_world_mesh(self):
-        from elasticdl_tpu.parallel.mesh import (
-            DATA_AXIS,
-            MODEL_AXIS,
-            ZERO_AXIS,
-            process_grouped_devices,
-        )
-
         mp = self._model_parallel_size
         n = len(jax.devices())
         local_n = jax.local_device_count()
@@ -532,7 +531,6 @@ class AllReduceTrainer(JaxTrainer):
         replication is resharded by GSPMD to mirror the param layout
         after the first step)."""
         if self._zero1 and not self._tp_active():
-            from elasticdl_tpu.parallel.mesh import ZERO_AXIS
             from elasticdl_tpu.parallel.zero1 import (
                 weight_update_shardings,
             )
@@ -664,7 +662,6 @@ class AllReduceTrainer(JaxTrainer):
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
-        from elasticdl_tpu.parallel.mesh import ZERO_AXIS, batch_axes
         from elasticdl_tpu.parallel.quantized import quantized_pmean
 
         axes = batch_axes(self._mesh)
@@ -766,8 +763,6 @@ class AllReduceTrainer(JaxTrainer):
         return self._run_sharded_step(features, labels)
 
     def _run_sharded_step(self, features, labels):
-        from elasticdl_tpu.parallel.mesh import data_parallel_size
-
         n_data = data_parallel_size(self._mesh)
         padded_f, real_n = pad_batch_to_multiple(features, n_data)
         padded_l, _ = pad_batch_to_multiple(labels, n_data)
