@@ -178,6 +178,8 @@ class MasterServicer:
         )
         for wid, age in last_seen_ago.items():
             res.worker_last_seen_ago[wid] = age
+        for wid, n in stats["doing_by_worker"].items():
+            res.worker_doing_tasks[wid] = n
         if (
             self._evaluation_service is not None
             and self._evaluation_service.completed_results

@@ -464,9 +464,13 @@ class TaskDispatcher:
     def stats(self):
         """Telemetry snapshot for monitors / the metrics service."""
         with self._lock:
+            doing_by_worker = {}
+            for wid, _, _ in self._doing.values():
+                doing_by_worker[wid] = doing_by_worker.get(wid, 0) + 1
             return {
                 "todo": len(self._todo),
                 "doing": len(self._doing),
+                "doing_by_worker": doing_by_worker,
                 "epoch": self._epoch,
                 "num_epochs": self._num_epochs,
                 "records_done": self._records_done,

@@ -143,6 +143,11 @@ class AllReduceTrainer(JaxTrainer):
         self._mesh = None
         self._sharded_steps = {}  # real_n -> jitted step
         self._local_forward = None  # multi-host eval path, built lazily
+        # Multi-host eval host copy, keyed on (group_id, version): an eval
+        # task runs many minibatches against ONE model version, and a
+        # fresh jax.device_get per minibatch re-downloads the whole model
+        # each time (~0.9 GB for the flagship). One transfer per version.
+        self._eval_host_cache = None  # ((group_id, version), host_vars)
         self._steps_since_check = 0
         # Guards the (variables, opt_state, version) triple: the broadcast
         # server reads it from gRPC threads while the training thread swaps
@@ -184,6 +189,10 @@ class AllReduceTrainer(JaxTrainer):
         # weights paired with init-time optimizer moments.
         with self._state_lock:
             super().restore_variables(exported)
+            # The restored version can collide with the cached one (e.g.
+            # resuming the same step the cache was made at, with different
+            # weights on disk): drop the eval host copy unconditionally.
+            self._eval_host_cache = None
 
     def _state_provider(self):
         with self._state_lock:
@@ -787,6 +796,10 @@ class AllReduceTrainer(JaxTrainer):
             self._variables = new_variables
             self._opt_state = new_opt_state
             self._version += 1
+            # The eval host copy is stale from this step on; free it now
+            # rather than pinning ~model-size host RAM until the next
+            # eval task happens to overwrite it.
+            self._eval_host_cache = None
         return loss
 
     def evaluate_minibatch(self, features, model_version=-1):
@@ -799,10 +812,22 @@ class AllReduceTrainer(JaxTrainer):
         # mesh, but evaluation tasks are dispatched to ONE worker — a
         # global-mesh forward would need every process to participate.
         # Pull a host copy and run the forward on this process's local
-        # devices only (eval is forward-only and rare; the copy is cheap
-        # next to a lease of training steps).
+        # devices only. The copy is cached keyed on (group_id, version):
+        # an eval task's many minibatches all see one model version, and
+        # re-downloading the model per minibatch is ~0.9 GB of host
+        # transfer each for the flagship. A world change bumps group_id
+        # (old-world device arrays are torn down), a train step bumps
+        # version — either invalidates.
         with self._state_lock:
-            host_vars = jax.device_get(self._variables)
+            key = (self._group_id, self._version)
+            if (
+                self._eval_host_cache is not None
+                and self._eval_host_cache[0] == key
+            ):
+                host_vars = self._eval_host_cache[1]
+            else:
+                host_vars = jax.device_get(self._variables)
+                self._eval_host_cache = (key, host_vars)
         if self._local_forward is None:
             self._local_forward = jax.jit(
                 lambda v, f: self._model.apply(v, f, training=False)

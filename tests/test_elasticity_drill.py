@@ -59,7 +59,16 @@ def test_kill_worker_mid_job_drill(tmp_path, strategy, num_ps):
     )
     assert result["completed"], result.get("log_tail", "")[-1500:]
     assert result["relaunched"], "worker was never relaunched"
-    assert result["recovered_tasks"], "dead worker's tasks not recovered"
+    # run_drill SIGSTOPped the victim and verified it owned an in-flight
+    # task before the SIGKILL, so recovery must log; on failure, show the
+    # master's queue state at kill time so a real regression is
+    # distinguishable from drill slowness.
+    assert result["recovered_tasks"], (
+        "dead worker's tasks not recovered; "
+        f"status_at_kill={result.get('status_at_kill')} "
+        f"victim_task_observed={result.get('victim_task_observed')}\n"
+        f"{result.get('log_tail', '')[-1500:]}"
+    )
     assert result["rejoin_s"] is not None, result
     # Elastic rejoin: detection + relaunch + re-init + first RPC. Bound it
     # loosely (CI boxes vary) — the metric's existence and sanity is the
@@ -153,6 +162,9 @@ def test_kill_worker_mid_job_multihost_lease_drill(
             **env,
         },
         timeout=540,
+        # A SIGSTOPped rank would stall the whole SPMD world's
+        # collectives; this drill asserts rejoin, not task recovery.
+        require_victim_task=False,
     )
     assert result["completed"], result.get("log_tail", "")[-1500:]
     assert result["relaunched"], "worker was never relaunched"

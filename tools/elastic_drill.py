@@ -94,11 +94,18 @@ def run_drill(
     extra_args=(),
     env_overrides=None,
     timeout=300,
+    require_victim_task=True,
 ):
     """strategy: explicit --distribution_strategy name; default derives
     from num_ps (ParameterServerStrategy when PS shards are requested,
     Local otherwise). Pass "AllreduceStrategy" to drill the elastic
-    membership/broadcast path."""
+    membership/broadcast path.
+
+    require_victim_task: gate the SIGKILL on the victim provably owning an
+    in-flight task (see the freeze loop below) so task recovery is
+    deterministic. Disable for multi-host lease drills: a SIGSTOPped rank
+    stalls the whole SPMD world's collectives, and those drills assert
+    rejoin, not per-task recovery."""
     import grpc
 
     from elasticdl_tpu.common import rpc
@@ -174,10 +181,64 @@ def run_drill(
                 break
             time.sleep(0.2)
 
-        # The drill: SIGKILL worker 0 (preemption).
+        # The drill: SIGKILL worker 0 (preemption). When the caller wants
+        # the kill to provably strand recoverable work (require_victim_task),
+        # freeze the victim FIRST and only deliver the SIGKILL once the
+        # master shows it owning an in-flight task: tasks on this tiny
+        # model finish in milliseconds, so an unsynchronized kill can land
+        # in the report-done -> next-get_task window where the worker owns
+        # nothing — then there is nothing to recover and the drill's
+        # "Recovered" assertion is timing-flaky under host load (the exact
+        # round-4 full-suite failure). SIGSTOP makes the observation
+        # stable: a stopped worker can't complete the task out from under
+        # the check (a brief settle lets an already-in-flight report-done
+        # land before the ownership read).
         victim = _find_worker_pid(0, port)
-        os.kill(victim, signal.SIGKILL)
-        t_kill = time.time()
+        t_freeze = None
+        if require_victim_task:
+            freeze_deadline = time.time() + 30
+            try:
+                while True:
+                    # The master's detection clock starts when heartbeats
+                    # stop — at the SIGSTOP, not at the later SIGKILL; the
+                    # rejoin metric must be measured from here.
+                    t_freeze = time.time()
+                    os.kill(victim, signal.SIGSTOP)
+                    time.sleep(0.1)  # drain any in-flight report RPC
+                    fresh = status(time.time() + 10)
+                    if fresh is not None:
+                        s = fresh
+                    # Only a FRESH post-freeze observation proves the
+                    # victim holds recoverable work; a stale snapshot (or
+                    # an unreachable/drained master) must not satisfy the
+                    # gate — mark unobserved and kill anyway.
+                    if (
+                        fresh is not None
+                        and dict(fresh.worker_doing_tasks).get(0, 0) > 0
+                    ):
+                        break
+                    if fresh is None or time.time() > freeze_deadline:
+                        result["victim_task_observed"] = False
+                        break
+                    os.kill(victim, signal.SIGCONT)
+                    time.sleep(0.05)
+            except ProcessLookupError:
+                # The victim exited during a CONT window (e.g. the job
+                # drained): nothing left to freeze or prove.
+                result["victim_task_observed"] = False
+            result.setdefault("victim_task_observed", True)
+            result["status_at_kill"] = {
+                "todo": int(s.todo_tasks),
+                "doing": int(s.doing_tasks),
+                "worker_doing_tasks": dict(s.worker_doing_tasks),
+            }
+        try:
+            os.kill(victim, signal.SIGKILL)
+        except ProcessLookupError:
+            pass  # already gone; the relaunch checks below still apply
+        # Freeze-gated kills were last SIGSTOPped (never resumed) at
+        # t_freeze — the instant the worker went silent.
+        t_kill = t_freeze if t_freeze is not None else time.time()
         result["killed_worker"] = victim
         result["records_at_kill"] = int(s.records_done)
 
