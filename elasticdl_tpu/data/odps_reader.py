@@ -31,6 +31,24 @@ DEFAULT_PAGE_RECORDS = 4096
 DEFAULT_MAX_RETRIES = 3
 
 
+def retrying(fn, what, max_retries, base_seconds, log=logger):
+    """Run fn() up to max_retries times with exponential backoff — the
+    one retry policy shared by the ODPS reader and writer."""
+    for attempt in range(max_retries):
+        try:
+            return fn()
+        except Exception:
+            if attempt == max_retries - 1:
+                raise
+            delay = base_seconds * (2 ** attempt)
+            log.warning(
+                "ODPS %s failed (attempt %d/%d); retrying in %.1fs",
+                what, attempt + 1, max_retries, delay,
+                exc_info=True,
+            )
+            time.sleep(delay)
+
+
 def _default_client(project, access_id, access_key, endpoint):
     try:
         from odps import ODPS  # pyodps, not baked into this image
@@ -185,20 +203,9 @@ class OdpsReader(AbstractDataReader):
         return self._retrying(fetch, f"page@{start}")
 
     def _retrying(self, fn, what):
-        """Run fn() up to max_retries times with exponential backoff."""
-        for attempt in range(self._max_retries):
-            try:
-                return fn()
-            except Exception:
-                if attempt == self._max_retries - 1:
-                    raise
-                delay = self._retry_base_seconds * (2 ** attempt)
-                logger.warning(
-                    "ODPS %s failed (attempt %d/%d); retrying in %.1fs",
-                    what, attempt + 1, self._max_retries, delay,
-                    exc_info=True,
-                )
-                time.sleep(delay)
+        return retrying(
+            fn, what, self._max_retries, self._retry_base_seconds
+        )
 
 
 def parse_odps_origin(origin):

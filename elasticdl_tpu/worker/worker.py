@@ -273,6 +273,11 @@ class Worker:
                     records, processor
                 ),
             )
+        # Optional end-of-stream hook: buffering processors (e.g. the
+        # ODPS writer's) flush their tail here.
+        close = getattr(processor, "close", None)
+        if close is not None:
+            close()
 
     def _drain_eval_tasks(self):
         while True:
