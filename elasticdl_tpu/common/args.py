@@ -64,6 +64,41 @@ def add_common_arguments(parser):
         "mesh gains a 'model' axis of this size and params are laid out by "
         "the model spec's param_specs(variables) hook (pure DP when 1)",
     )
+    parser.add_argument(
+        "--pipeline_stages",
+        type=int,
+        default=1,
+        help="pipeline-parallel depth for the AllReduce strategy: the "
+        "device mesh gains a 'stage' axis of this size and the model "
+        "spec's pipeline_spec(...) hook builds the staged step "
+        "(parallel/pipeline.py). In multi-host worlds the stage axis "
+        "stays inside each process, like the model axis (no pipelining "
+        "when 1)",
+    )
+    parser.add_argument(
+        "--pipeline_schedule",
+        default="1f1b",
+        choices=["gpipe", "1f1b", "interleaved"],
+        help="microbatch schedule when --pipeline_stages > 1: gpipe "
+        "(scan autodiff, O(microbatches) activation memory), 1f1b "
+        "(O(stages) memory, vocab-parallel head), or interleaved 1F1B "
+        "(virtual chunks, smaller bubble)",
+    )
+    parser.add_argument(
+        "--pipeline_microbatches",
+        type=int,
+        default=0,
+        help="microbatches per minibatch for the pipeline schedules "
+        "(0: auto = 2 * pipeline_stages; more microbatches amortize the "
+        "pipeline bubble at the cost of smaller per-stage matmuls)",
+    )
+    parser.add_argument(
+        "--pipeline_virtual_stages",
+        type=int,
+        default=2,
+        help="virtual chunks per device for "
+        "--pipeline_schedule interleaved (ignored by other schedules)",
+    )
 
 
 def add_data_arguments(parser):
@@ -260,6 +295,29 @@ def validate_args(args):
         raise ValueError(
             "--num_workers >= 1 is required (or --instance_backend none "
             "when workers are launched externally)"
+        )
+    # Pipeline parallelism composes with DP (the stage axis pairs with the
+    # data axis) but not yet with TP — both claim the intra-process device
+    # slice, and no model spec lays params out over both at once. Fail
+    # loudly instead of silently picking one.
+    pipeline_stages = getattr(args, "pipeline_stages", 1) or 1
+    if pipeline_stages > 1:
+        if (
+            getattr(args, "distribution_strategy", None)
+            not in (None, DistributionStrategy.ALLREDUCE)
+        ):
+            raise ValueError(
+                "--pipeline_stages > 1 requires the AllReduce strategy"
+            )
+        if getattr(args, "model_parallel_size", 1) > 1:
+            raise ValueError(
+                "--pipeline_stages and --model_parallel_size cannot be "
+                "combined (both lay out the intra-process device slice); "
+                "pick one"
+            )
+    if getattr(args, "pipeline_microbatches", 0) < 0:
+        raise ValueError(
+            "--pipeline_microbatches must be >= 0 (0 = auto)"
         )
     # The coordination port rotates over a 16-port block across membership
     # epochs (master/membership.py): a master_port inside the block would

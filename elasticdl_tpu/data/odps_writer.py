@@ -222,7 +222,15 @@ class OdpsPredictionOutputsProcessor(BasePredictionOutputsProcessor):
         if not self._buffer:
             return 0
         rows, self._buffer = self._buffer, []
-        return self._writer.from_iterator(iter(rows), self._worker_id)
+        try:
+            return self._writer.from_iterator(iter(rows), self._worker_id)
+        except Exception:
+            # The buffer holds rows from tasks already reported done;
+            # dropping them on a failed write would be at-most-once (the
+            # master only re-dispatches the CURRENT task). Restore so the
+            # next flush/close retries them — at-least-once as documented.
+            self._buffer = rows + self._buffer
+            raise
 
     def close(self):
         """Flush any buffered rows; the worker calls this after its last

@@ -43,6 +43,10 @@ _WORKER_RELAY_ARGS = [
     "log_loss_steps",
     "seed",
     "model_parallel_size",
+    "pipeline_stages",
+    "pipeline_schedule",
+    "pipeline_microbatches",
+    "pipeline_virtual_stages",
     "multi_host",
     "zero1",
     "quantized_grads",

@@ -247,6 +247,59 @@ def param_specs(variables):
     }
 
 
+def pipeline_spec(mesh, n_stages, num_microbatches, schedule="1f1b",
+                  batch_axis=None, virtual_stages=2, config=None):
+    """Model-spec stage hook for pipeline parallelism (worker
+    --pipeline_stages N --pipeline_schedule {gpipe,1f1b,interleaved}), the
+    staged twin of the param_specs hook: returns a
+    parallel.pipeline.PipelineBuild binding this LM's Block stack to the
+    requested schedule on `mesh`'s "stage" axis. All three schedules share
+    one param tree ({embed, stages[rows], head}), so checkpoints and
+    optimizer state transfer between them, and the schedule-free apply_fn
+    (make_lm_sequential) evaluates/predicts on any mesh."""
+    from elasticdl_tpu.parallel import pipeline as plib
+
+    cfg = config or LMConfig()
+    total_rows = n_stages
+    if schedule == "interleaved":
+        from elasticdl_tpu.parallel.pipeline_interleaved import (
+            make_lm_pipeline_interleaved,
+        )
+
+        total_rows = n_stages * virtual_stages
+        init_fn, lg_fn = make_lm_pipeline_interleaved(
+            cfg, mesh, n_stages, virtual_stages, num_microbatches,
+            batch_axis=batch_axis,
+        )
+    elif schedule == "1f1b":
+        init_fn, lg_fn = plib.make_lm_pipeline_1f1b(
+            cfg, mesh, n_stages, num_microbatches, batch_axis=batch_axis
+        )
+    elif schedule == "gpipe":
+        init_fn, train_apply = plib.make_lm_pipeline(
+            cfg, mesh, n_stages, num_microbatches, batch_axis=batch_axis
+        )
+
+        def lg_fn(params, tokens, labels, rng=None):
+            def loss_of(p):
+                rngs = {"dropout": rng} if rng is not None else None
+                return loss(
+                    labels, train_apply(p, tokens, training=True, rngs=rngs)
+                )
+
+            return jax.value_and_grad(loss_of)(params)
+
+    else:
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+
+    apply_fn = plib.make_lm_sequential(cfg, total_rows)
+
+    def param_specs_fn(params):
+        return plib.lm_pipeline_param_specs(params)
+
+    return plib.PipelineBuild(init_fn, lg_fn, apply_fn, param_specs_fn)
+
+
 def token_ce(outputs, labels):
     """Per-token CE from logits (numpy; eval-metric building block, also
     reused by the MoE variant on its logits field)."""

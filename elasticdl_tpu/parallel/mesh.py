@@ -17,6 +17,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+# Pipeline-parallel stage axis (parallel/pipeline.py): stacked per-stage
+# params shard their leading dim over it. Like MODEL_AXIS it never crosses
+# process boundaries (the multi-host composition invariant documented in
+# worker/allreduce_trainer.py).
+STAGE_AXIS = "stage"
 # Intra-process slice of the data dimension, used by multi-host ZeRO-1:
 # optimizer state shards over it while staying replicated across processes,
 # so every process keeps a fully-addressable copy (elastic regroups can

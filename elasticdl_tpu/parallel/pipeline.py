@@ -29,10 +29,27 @@ batch dim is sharded over "data" while params shard over "stage"; every
 collective here names only the stage axis.
 """
 
+import collections
 import functools
 
 import jax
 import jax.numpy as jnp
+
+# What a model spec's `pipeline_spec(...)` hook hands the AllReduce trainer
+# (worker --pipeline_stages; the stage-hook twin of the param_specs hook):
+#   init_fn(rng, sample_features) -> params        (staged param tree)
+#   loss_and_grads_fn(params, features, labels, rng=None) -> (loss, grads)
+#       the scheduled training step; call inside jit on a mesh whose
+#       "stage" axis matches the build
+#   apply_fn(params, features, training=False, rngs=None) -> outputs
+#       schedule-free forward over the SAME param tree, valid on any mesh
+#       (no stage axis needed) — evaluation/prediction, and the trainer's
+#       sequential pure-DP fallback when a world can't host the stage axis
+#   param_specs_fn(params) -> PartitionSpec tree for the staged params
+PipelineBuild = collections.namedtuple(
+    "PipelineBuild",
+    ["init_fn", "loss_and_grads_fn", "apply_fn", "param_specs_fn"],
+)
 
 
 def pipeline_apply(stage_fn, stage_params, x_micro, axis_name="stage",
@@ -295,6 +312,82 @@ def make_lm_pipeline(cfg, mesh, n_stages, num_microbatches,
         return head_mod.apply({"params": params["head"]}, y)
 
     return init_fn, apply_fn
+
+
+def make_lm_sequential(cfg, total_rows):
+    """Schedule-free forward over the pipelined LM param tree: embed ->
+    lax.scan over the stacked stage rows -> head. Mathematically identical
+    to the monolithic TransformerLM (the stacked rows ARE the layer stack,
+    in order: gpipe/1f1b stack stages 0..N-1 and the interleaved build's
+    public tree is chunk-ordered, i.e. also sequential). Needs no mesh and
+    no stage axis, so it serves as (a) the evaluation/prediction forward —
+    eval tasks run on ONE worker's local devices — and (b) the trainer's
+    pure-DP fallback when an elastic world can't host the stage axis,
+    keeping the param tree (and therefore checkpoints, broadcasts, and
+    optimizer state) intact across the degradation.
+
+    total_rows: leading dim of params["stages"] (n_stages, or
+    n_stages * virtual chunks for the interleaved build)."""
+    import flax.linen as nn
+
+    from elasticdl_tpu.models.transformer.transformer_lm import (
+        Block,
+        embed_input,
+        head_output,
+    )
+
+    if cfg.n_layers % total_rows:
+        raise ValueError(
+            f"n_layers {cfg.n_layers} not divisible by {total_rows} "
+            f"stage rows"
+        )
+    layers_per_row = cfg.n_layers // total_rows
+
+    class EmbedIn(nn.Module):
+        @nn.compact
+        def __call__(self, tokens):
+            return embed_input(cfg, tokens)
+
+    class Stage(nn.Module):
+        @nn.compact
+        def __call__(self, x, training=False):
+            for _ in range(layers_per_row):
+                x = Block(cfg)(x, training)
+            return x
+
+    class HeadOut(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return head_output(cfg, x)
+
+    embed_mod, stage_mod, head_mod = EmbedIn(), Stage(), HeadOut()
+
+    def apply_fn(params, tokens, training=False, rngs=None):
+        x = embed_mod.apply({"params": params["embed"]}, tokens)
+        dropout_rng = (rngs or {}).get("dropout")
+        if bool(cfg.dropout) and training and dropout_rng is not None:
+            keys = jax.random.split(dropout_rng, total_rows)
+
+            def body(h, xs):
+                row_p, key = xs
+                return (
+                    stage_mod.apply(
+                        {"params": row_p}, h, training,
+                        rngs={"dropout": key},
+                    ),
+                    None,
+                )
+
+            x, _ = jax.lax.scan(body, x, (params["stages"], keys))
+        else:
+
+            def body(h, row_p):
+                return stage_mod.apply({"params": row_p}, h, training), None
+
+            x, _ = jax.lax.scan(body, x, params["stages"])
+        return head_mod.apply({"params": params["head"]}, x)
+
+    return apply_fn
 
 
 # ---------- 1F1B schedule ----------

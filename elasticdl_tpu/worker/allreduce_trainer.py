@@ -56,6 +56,7 @@ from elasticdl_tpu.parallel import broadcast, distributed
 from elasticdl_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
+    STAGE_AXIS,
     ZERO_AXIS,
     batch_axes,
     data_parallel_size,
@@ -98,10 +99,61 @@ class AllReduceTrainer(JaxTrainer):
         param_specs_fn=None,
         zero1=False,
         quantized_grads=False,
+        pipeline_stages=1,
+        pipeline_schedule="1f1b",
+        pipeline_microbatches=0,
+        pipeline_virtual_stages=2,
+        pipeline_spec_fn=None,
     ):
         super().__init__(model, loss_fn, optimizer_spec, seed=seed)
         self._model_parallel_size = max(1, int(model_parallel_size or 1))
         self._param_specs_fn = param_specs_fn
+        # Pipeline parallelism (parallel/pipeline.py): the model spec's
+        # pipeline_spec hook builds the staged step; the mesh gains a
+        # "stage" axis laid out like the model axis (intra-process in
+        # multi-host worlds — the composition invariant above). The staged
+        # param tree replaces the monolithic one, so ALL of the elastic
+        # machinery (snapshot, broadcast, checkpoint) carries it untouched;
+        # worlds that can't host the stage axis degrade to running the
+        # same staged tree sequentially under pure DP (the schedule-free
+        # apply in the PipelineBuild), keeping state intact.
+        self._pipeline_stages = max(1, int(pipeline_stages or 1))
+        self._pipeline_schedule = pipeline_schedule
+        self._pipeline_microbatches = int(pipeline_microbatches or 0) or (
+            2 * self._pipeline_stages
+        )
+        self._pipeline_vstages = max(1, int(pipeline_virtual_stages or 1))
+        self._pipeline_spec_fn = pipeline_spec_fn
+        self._pipeline_build = None
+        if self._pipeline_stages > 1 and pipeline_spec_fn is None:
+            logger.warning(
+                "pipeline_stages %d requested but the model spec has no "
+                "pipeline_spec hook; running unpipelined",
+                self._pipeline_stages,
+            )
+            self._pipeline_stages = 1
+        if self._pipeline_stages > 1:
+            if self._model_parallel_size > 1:
+                raise ValueError(
+                    "pipeline_stages and model_parallel_size cannot be "
+                    "combined (both lay out the intra-process device "
+                    "slice); pick one"
+                )
+            if zero1:
+                logger.warning(
+                    "zero1 is ignored under pipeline parallelism (stage "
+                    "params already shard over the stage axis; the "
+                    "optimizer layout follows them)"
+                )
+                zero1 = False
+            if quantized_grads:
+                logger.warning(
+                    "quantized_grads is ignored under pipeline "
+                    "parallelism (the data-axis reduction happens inside "
+                    "the pipeline's shard_map, which has no quantized "
+                    "variant yet)"
+                )
+                quantized_grads = False
         # Cross-replica weight-update sharding (ZeRO-1, parallel/zero1.py):
         # optimizer state shards over the data axis (single process) or the
         # intra-process "zero" axis (multi-host — see the module docstring's
@@ -261,6 +313,7 @@ class AllReduceTrainer(JaxTrainer):
         logger.info("Mesh axes: %s", dict(self._mesh.shape))
         self._sharded_steps = {}
         self._local_forward = None  # compiled against the torn-down backend
+        self._rebuild_pipeline_build()
         if self._multi_host and jax.process_count() > 1:
             # SPMD world: sync state through an on-mesh collective that
             # EVERY member executes right after the rendezvous, instead of
@@ -430,6 +483,37 @@ class AllReduceTrainer(JaxTrainer):
         n = len(jax.devices())
         local_n = jax.local_device_count()
         multi_proc = jax.process_count() > 1
+        pp = self._pipeline_stages
+        if pp > 1:
+            # Same feasibility ladder as the model axis below: the stage
+            # axis must divide the devices, and in multi-host worlds must
+            # stay inside one process (stage hops ride intra-host ICI and
+            # every process keeps fully-addressable params for regroup
+            # snapshots). Infeasible worlds degrade to pure DP — the
+            # staged param tree keeps training through the schedule-free
+            # sequential apply (see _pipeline_step_fn).
+            if n % pp != 0:
+                logger.warning(
+                    "pipeline_stages %d does not divide %d devices; "
+                    "running the staged model sequentially under pure "
+                    "data parallelism for this world", pp, n,
+                )
+            elif multi_proc and local_n % pp != 0:
+                logger.warning(
+                    "pipeline_stages %d does not divide the %d local "
+                    "devices of each process; multi-host pipelining "
+                    "requires an intra-process stage axis — running the "
+                    "staged model sequentially under pure data "
+                    "parallelism for this world", pp, local_n,
+                )
+            elif multi_proc:
+                return make_mesh(
+                    {DATA_AXIS: -1, STAGE_AXIS: pp},
+                    devices=process_grouped_devices(),
+                )
+            else:
+                return make_mesh({DATA_AXIS: -1, STAGE_AXIS: pp})
+            return make_mesh()
         if mp > 1 and self._param_specs_fn is None:
             # A model axis without param layouts would just duplicate the
             # same DP computation mp times — half (or worse) of the
@@ -574,11 +658,66 @@ class AllReduceTrainer(JaxTrainer):
             and self._mesh.shape["model"] > 1
         )
 
+    def _pp_active(self):
+        """True when the current mesh really hosts the stage axis (the
+        scheduled pipeline runs); a staged build on a pure-DP fallback
+        mesh trains sequentially instead."""
+        return (
+            self._pipeline_build is not None
+            and STAGE_AXIS in self._mesh.shape
+            and self._mesh.shape[STAGE_AXIS] > 1
+        )
+
+    def _rebuild_pipeline_build(self):
+        """(Re)bind the model spec's pipeline_spec hook to the current
+        mesh. Runs on every world change — the factories close over the
+        mesh. A hook that rejects the configuration (e.g. layer count not
+        divisible by the stage count) downgrades to the monolithic model
+        permanently: the rejection is config-determined, so every world
+        would reject it the same way and the param tree stays consistent
+        across regroups."""
+        self._pipeline_build = None
+        if self._pipeline_stages <= 1 or self._pipeline_spec_fn is None:
+            return
+        try:
+            self._pipeline_build = self._pipeline_spec_fn(
+                mesh=self._mesh,
+                n_stages=self._pipeline_stages,
+                num_microbatches=self._pipeline_microbatches,
+                schedule=self._pipeline_schedule,
+                batch_axis=DATA_AXIS,
+                virtual_stages=self._pipeline_vstages,
+            )
+        except ValueError as e:
+            logger.warning(
+                "pipeline_spec hook rejected the configuration (%s); "
+                "running the monolithic model data-parallel", e,
+            )
+            self._pipeline_stages = 1
+            # The mesh just built may carry a stage axis the monolithic
+            # step would duplicate compute over; rebuild without it (and
+            # re-log, so the earlier "Mesh axes" line can't read as
+            # pipelining being active).
+            self._mesh = self._make_world_mesh()
+            self._sharded_steps = {}
+            logger.info("Mesh axes: %s", dict(self._mesh.shape))
+
     def _variables_sharding(self, variables):
         """NamedSharding layout for the variables pytree: the model-spec's
         param_specs when running TP, else replicated."""
         from jax.sharding import NamedSharding, PartitionSpec
 
+        if self._pp_active():
+            specs = self._pipeline_build.param_specs_fn(
+                variables["params"]
+            )
+            return {
+                "params": jax.tree_util.tree_map(
+                    lambda s: NamedSharding(self._mesh, s),
+                    specs,
+                    is_leaf=lambda v: isinstance(v, PartitionSpec),
+                )
+            }
         if not self._tp_active():
             return replicated_sharding(self._mesh)
         # Safety net for the rare path where the mesh was built before
@@ -623,7 +762,9 @@ class AllReduceTrainer(JaxTrainer):
             # the reference's ragged-last-batch Horovod averaging.
             slice_to = real_n if jax.process_count() == 1 else None
 
-            if self._quantized_grads and not self._tp_active():
+            if self._pipeline_build is not None:
+                step_fn = self._pipeline_step_fn()
+            elif self._quantized_grads and not self._tp_active():
                 step_fn = self._quantized_step_fn()
             else:
                 def step_fn(variables, opt_state, rng, features, labels):
@@ -644,9 +785,11 @@ class AllReduceTrainer(JaxTrainer):
             # data-axis dim-0 sharding so the update compiles as
             # reduce-scatter -> shard-local math -> all-gather.
             var_sh = self._variables_sharding(self._variables)
+            # Under TP and pipeline, optimizer-state shardings propagate
+            # from the param layout (GSPMD); ZeRO-1/replicated otherwise.
             opt_sh = (
                 None
-                if self._tp_active()
+                if self._tp_active() or self._pp_active()
                 else self._opt_placement(self._opt_state)
             )
             step = jax.jit(
@@ -711,9 +854,112 @@ class AllReduceTrainer(JaxTrainer):
 
         return step_fn
 
+    def _pipeline_step_fn(self):
+        """Training step over the staged param tree: the scheduled
+        loss_and_grads when the mesh hosts the stage axis, the
+        schedule-free sequential apply (plain DP value_and_grad) when an
+        elastic world degraded the mesh to pure data parallelism. Either
+        way the optimizer update runs on the same tree, so transitions
+        between the two keep (params, opt_state) bit-compatible. The loss
+        is over the whole padded batch (cyclic repetition), the same
+        ragged-last-batch semantics documented in _sharded_step_for for
+        multi-host runs."""
+        import optax
+
+        build = self._pipeline_build
+        if self._pp_active():
+            lg = build.loss_and_grads_fn
+        else:
+            apply_fn = build.apply_fn
+
+            def lg(params, features, labels, rng=None):
+                def loss_of(p):
+                    rngs = {"dropout": rng} if rng is not None else None
+                    return self._loss_fn(
+                        labels,
+                        apply_fn(p, features, training=True, rngs=rngs),
+                    )
+
+                return jax.value_and_grad(loss_of)(params)
+
+        def step_fn(variables, opt_state, rng, features, labels):
+            params = variables["params"]
+            loss, grads = lg(params, features, labels, rng)
+            updates, new_opt_state = self._optax.update(
+                grads, opt_state, params
+            )
+            new_params = optax.apply_updates(params, updates)
+            return {"params": new_params}, new_opt_state, loss
+
+        return step_fn
+
+    def _init_pipeline_variables(self, features):
+        """Lazy init for pipeline mode: params come from the build's
+        init_fn (staged tree), not self._model.init."""
+        import jax.numpy as jnp
+
+        self._rng, init_rng = jax.random.split(self._rng)
+        params = self._pipeline_build.init_fn(
+            init_rng, jnp.asarray(np.asarray(features))
+        )
+        variables = {"params": params}
+        with self._state_lock:
+            self._variables = jax.device_put(
+                variables, self._variables_sharding(variables)
+            )
+            self._opt_state = jax.device_put(
+                self._optax.init(self._variables["params"]),
+                self._opt_placement(None),
+            )
+        n_params = sum(
+            int(np.prod(p.shape))
+            for p in jax.tree_util.tree_leaves(params)
+        )
+        logger.info(
+            "Initialized pipelined model with %d parameters "
+            "(%d stage rows, schedule %s)",
+            n_params,
+            jax.tree_util.tree_leaves(params["stages"])[0].shape[0],
+            self._pipeline_schedule if self._pp_active() else "sequential",
+        )
+        self._forward = self._build_forward()
+        if self.restore_on_init:
+            from elasticdl_tpu.common.save_utils import (
+                restore_trainer_checkpoint,
+            )
+
+            path, self.restore_on_init = self.restore_on_init, None
+            restore_trainer_checkpoint(self, path)
+
+    def _build_forward(self):
+        if self._pipeline_build is not None:
+            apply_fn = self._pipeline_build.apply_fn
+
+            def forward(variables, features):
+                return apply_fn(
+                    variables["params"], features, training=False
+                )
+
+            return jax.jit(forward)
+        return super()._build_forward()
+
     # ---------- Trainer interface ----------
 
     def init_variables_if_needed(self, features):
+        if self._pipeline_stages > 1:
+            if self._mesh is None:
+                self.init_world_if_needed(force=True)
+                if self._variables is not None:
+                    # Restored-before-world state (checkpoint resume):
+                    # any forward built before the pipeline build existed
+                    # compiled against the monolithic tree — rebuild.
+                    self._forward = self._build_forward()
+            if self._pipeline_build is not None:
+                if self._variables is None:
+                    self._init_pipeline_variables(features)
+                return
+            # The hook rejected the config during world init: fall through
+            # to the monolithic path below (stages was reset to 1).
         first_init = self._variables is None
         super().init_variables_if_needed(features)
         if self._mesh is None:
@@ -773,8 +1019,13 @@ class AllReduceTrainer(JaxTrainer):
 
     def _run_sharded_step(self, features, labels):
         n_data = data_parallel_size(self._mesh)
-        padded_f, real_n = pad_batch_to_multiple(features, n_data)
-        padded_l, _ = pad_batch_to_multiple(labels, n_data)
+        multiple = n_data
+        if self._pp_active():
+            # The pipeline splits the batch into M microbatches, each
+            # sharded over the data axis: B must divide by M * dp.
+            multiple = n_data * self._pipeline_microbatches
+        padded_f, real_n = pad_batch_to_multiple(features, multiple)
+        padded_l, _ = pad_batch_to_multiple(labels, multiple)
         padded_n = jax.tree_util.tree_leaves(padded_f)[0].shape[0]
         step = self._sharded_step_for(real_n, padded_n)
         # Derive the dropout key from the SHARED model version, not a local
@@ -829,9 +1080,17 @@ class AllReduceTrainer(JaxTrainer):
                 host_vars = jax.device_get(self._variables)
                 self._eval_host_cache = (key, host_vars)
         if self._local_forward is None:
-            self._local_forward = jax.jit(
-                lambda v, f: self._model.apply(v, f, training=False)
-            )
+            if self._pipeline_build is not None:
+                apply_fn = self._pipeline_build.apply_fn
+                self._local_forward = jax.jit(
+                    lambda v, f: apply_fn(
+                        v["params"], f, training=False
+                    )
+                )
+            else:
+                self._local_forward = jax.jit(
+                    lambda v, f: self._model.apply(v, f, training=False)
+                )
         outputs = self._local_forward(
             host_vars, jax.tree_util.tree_map(np.asarray, features)
         )

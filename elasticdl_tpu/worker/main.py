@@ -64,6 +64,11 @@ def build_trainer(args, spec, master_client):
             param_specs_fn=getattr(spec.module, "param_specs", None),
             zero1=args.zero1,
             quantized_grads=args.quantized_grads,
+            pipeline_stages=args.pipeline_stages,
+            pipeline_schedule=args.pipeline_schedule,
+            pipeline_microbatches=args.pipeline_microbatches,
+            pipeline_virtual_stages=args.pipeline_virtual_stages,
+            pipeline_spec_fn=getattr(spec.module, "pipeline_spec", None),
         )
     from elasticdl_tpu.worker.trainer import LocalTrainer
 

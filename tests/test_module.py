@@ -61,6 +61,95 @@ def param_specs(variables):
     return jax.tree_util.tree_map_with_path(spec, variables)
 
 
+PIPELINE_HIDDEN = FEATURE_DIM
+
+
+def pipeline_spec(mesh, n_stages, num_microbatches, schedule="gpipe",
+                  batch_axis=None, virtual_stages=2):
+    """Stage hook for the pipeline drills: a deep-linear regressor whose
+    hidden H->H stages pipeline over the "stage" mesh axis (in_proj ->
+    n_stages identity-initialized stage matmuls -> out_proj). Exactly
+    representable: effective weight = W_in @ prod(stages) @ W_out, checked
+    by pipeline_effective_weights. Only the generic GPipe schedule exists
+    for this toy (1f1b/interleaved are LM-specific vocab-parallel builds);
+    other requested schedules run GPipe."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from elasticdl_tpu.parallel import pipeline as plib
+
+    H = PIPELINE_HIDDEN
+
+    def stage_fn(p, x):
+        return x @ p["kernel"]
+
+    def init_fn(rng, sample_x):
+        # Identity embed/stages + small random head: near plain linear
+        # regression at init, so SGD at the spec's default lr stays
+        # stable despite the factored (deep-linear) parameterization.
+        k_out = jnp.asarray(rng)
+        lecun = jax.nn.initializers.lecun_normal()
+        return {
+            "embed": {
+                "kernel": jnp.eye(FEATURE_DIM, H, dtype=jnp.float32)
+            },
+            "stages": {
+                "kernel": jnp.tile(
+                    jnp.eye(H, dtype=jnp.float32)[None],
+                    (n_stages, 1, 1),
+                )
+            },
+            "head": {
+                "kernel": lecun(k_out, (H, 1), jnp.float32),
+                "bias": jnp.zeros((1,), jnp.float32),
+            },
+        }
+
+    def apply_fn(params, x, training=False, rngs=None):
+        h = x @ params["embed"]["kernel"]
+
+        def body(h, row):
+            return h @ row["kernel"], None
+
+        h, _ = jax.lax.scan(body, h, params["stages"])
+        return h @ params["head"]["kernel"] + params["head"]["bias"]
+
+    pipe = plib.make_pipeline(stage_fn, mesh, batch_axis=batch_axis)
+
+    def lg_fn(params, x, labels, rng=None):
+        def loss_of(p):
+            h = x @ p["embed"]["kernel"]
+            h_micro = plib.microbatch(h, num_microbatches)
+            y = plib.unmicrobatch(pipe(p["stages"], h_micro))
+            pred = y @ p["head"]["kernel"] + p["head"]["bias"]
+            return loss(labels, pred)
+
+        return jax.value_and_grad(loss_of)(params)
+
+    def param_specs_fn(params):
+        return {
+            "embed": jax.tree_util.tree_map(lambda _: P(), params["embed"]),
+            "stages": jax.tree_util.tree_map(
+                lambda _: P("stage"), params["stages"]
+            ),
+            "head": jax.tree_util.tree_map(lambda _: P(), params["head"]),
+        }
+
+    return plib.PipelineBuild(init_fn, lg_fn, apply_fn, param_specs_fn)
+
+
+def pipeline_effective_weights(npz):
+    """Effective (w, b) of an exported pipelined regressor checkpoint
+    (np.load of the worker's npz export)."""
+    w = npz["params/embed/kernel"]
+    stages = npz["params/stages/kernel"]
+    for i in range(stages.shape[0]):
+        w = w @ stages[i]
+    w = w @ npz["params/head/kernel"]
+    return w.reshape(-1), float(npz["params/head/bias"].reshape(-1)[0])
+
+
 def eval_metrics_fn():
     return {
         "mse": MeanMetric(
