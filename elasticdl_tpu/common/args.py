@@ -99,6 +99,26 @@ def add_common_arguments(parser):
         help="virtual chunks per device for "
         "--pipeline_schedule interleaved (ignored by other schedules)",
     )
+    parser.add_argument(
+        "--context_parallel_size",
+        type=int,
+        default=1,
+        help="sequence/context-parallel width for the AllReduce strategy: "
+        "the device mesh gains a 'seq' axis of this size and the model "
+        "spec's context_parallel_model(...) hook rebinds attention to it "
+        "(ring attention / Ulysses, parallel/ring_attention.py). "
+        "Composes with --model_parallel_size into a 3-D DPxTPxSP mesh. "
+        "Sequence length must divide by 2x this size (zigzag halves)",
+    )
+    parser.add_argument(
+        "--context_parallel_impl",
+        default="zigzag",
+        choices=["zigzag", "ring", "ulysses"],
+        help="sequence-parallel attention: zigzag (balanced causal ring, "
+        "default), ring (plain causal ring), or ulysses (all-to-all "
+        "head re-sharding; needs heads divisible by the seq axis and "
+        "does not compose with --model_parallel_size)",
+    )
 
 
 def add_data_arguments(parser):
@@ -319,6 +339,31 @@ def validate_args(args):
         raise ValueError(
             "--pipeline_microbatches must be >= 0 (0 = auto)"
         )
+    context_parallel = getattr(args, "context_parallel_size", 1) or 1
+    if context_parallel > 1:
+        if (
+            getattr(args, "distribution_strategy", None)
+            not in (None, DistributionStrategy.ALLREDUCE)
+        ):
+            raise ValueError(
+                "--context_parallel_size > 1 requires the AllReduce "
+                "strategy"
+            )
+        if pipeline_stages > 1:
+            raise ValueError(
+                "--context_parallel_size and --pipeline_stages cannot "
+                "be combined (no model spec stages a sequence-parallel "
+                "attention); pick one"
+            )
+        if (
+            getattr(args, "context_parallel_impl", "zigzag") == "ulysses"
+            and getattr(args, "model_parallel_size", 1) > 1
+        ):
+            raise ValueError(
+                "--context_parallel_impl ulysses does not compose with "
+                "--model_parallel_size (it re-shards heads itself); use "
+                "zigzag"
+            )
     # The coordination port rotates over a 16-port block across membership
     # epochs (master/membership.py): a master_port inside the block would
     # collide with a re-rendezvous after some elastic event.

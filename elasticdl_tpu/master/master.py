@@ -47,6 +47,8 @@ _WORKER_RELAY_ARGS = [
     "pipeline_schedule",
     "pipeline_microbatches",
     "pipeline_virtual_stages",
+    "context_parallel_size",
+    "context_parallel_impl",
     "multi_host",
     "zero1",
     "quantized_grads",

@@ -247,6 +247,54 @@ def param_specs(variables):
     }
 
 
+def context_parallel_model(mesh, axis_name="seq", batch_axis="data",
+                           head_axis=None, impl="zigzag", config=None):
+    """Model-spec hook for sequence/context parallelism (worker
+    --context_parallel_size): rebuild the LM with its attention bound to
+    `mesh`'s sequence axis — zigzag ring (balanced causal ring,
+    parallel/ring_attention.py), plain ring, or Ulysses all-to-all
+    (parallel/ulysses.py). The attention callable is parameterless, so
+    the param tree is IDENTICAL to the plain LM's: elastic transitions
+    between SP worlds and pure-DP worlds carry (params, opt_state)
+    untouched, and checkpoints are interchangeable. head_axis names a
+    tensor-parallel mesh axis to also shard heads over (ring only) for
+    the 3-D DP x TP x SP composition."""
+    cfg = config or LMConfig()
+    if impl == "zigzag":
+        from elasticdl_tpu.parallel.ring_attention import (
+            make_zigzag_ring_attention,
+        )
+
+        attn = make_zigzag_ring_attention(
+            mesh, axis_name=axis_name, causal=True,
+            batch_axis=batch_axis, head_axis=head_axis,
+        )
+    elif impl == "ring":
+        from elasticdl_tpu.parallel.ring_attention import (
+            make_ring_attention,
+        )
+
+        attn = make_ring_attention(
+            mesh, axis_name=axis_name, causal=True,
+            batch_axis=batch_axis, head_axis=head_axis,
+        )
+    elif impl == "ulysses":
+        if head_axis is not None:
+            raise ValueError(
+                "ulysses re-shards heads itself (all-to-all) and cannot "
+                "also shard them over a tensor-parallel axis; use "
+                "impl='zigzag' for the 3-D composition"
+            )
+        from elasticdl_tpu.parallel.ulysses import make_ulysses_attention
+
+        attn = make_ulysses_attention(
+            mesh, axis_name=axis_name, causal=True, batch_axis=batch_axis
+        )
+    else:
+        raise ValueError(f"unknown context-parallel impl {impl!r}")
+    return custom_model(dataclasses.replace(cfg, attention=attn))
+
+
 def pipeline_spec(mesh, n_stages, num_microbatches, schedule="1f1b",
                   batch_axis=None, virtual_stages=2, config=None):
     """Model-spec stage hook for pipeline parallelism (worker

@@ -56,6 +56,7 @@ from elasticdl_tpu.parallel import broadcast, distributed
 from elasticdl_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
+    SEQ_AXIS,
     STAGE_AXIS,
     ZERO_AXIS,
     batch_axes,
@@ -104,6 +105,9 @@ class AllReduceTrainer(JaxTrainer):
         pipeline_microbatches=0,
         pipeline_virtual_stages=2,
         pipeline_spec_fn=None,
+        context_parallel_size=1,
+        context_parallel_impl="zigzag",
+        context_parallel_model_fn=None,
     ):
         super().__init__(model, loss_fn, optimizer_spec, seed=seed)
         self._model_parallel_size = max(1, int(model_parallel_size or 1))
@@ -154,6 +158,52 @@ class AllReduceTrainer(JaxTrainer):
                     "variant yet)"
                 )
                 quantized_grads = False
+        # Sequence/context parallelism (parallel/ring_attention.py,
+        # parallel/ulysses.py): the mesh gains a "seq" axis (intra-process
+        # in multi-host worlds, like model/stage) and the TRAIN step runs
+        # a mesh-bound variant of the model whose attention is the ring /
+        # Ulysses callable from the model spec's context_parallel_model
+        # hook. The param tree is identical to the plain model's (the
+        # attention carries no params), so init, evaluation, checkpoints
+        # and elastic transitions all keep using self._model untouched.
+        self._context_parallel_size = max(
+            1, int(context_parallel_size or 1)
+        )
+        self._context_parallel_impl = context_parallel_impl
+        self._context_parallel_model_fn = context_parallel_model_fn
+        self._sp_model = None  # mesh-bound train model, rebuilt per world
+        if (
+            self._context_parallel_size > 1
+            and context_parallel_model_fn is None
+        ):
+            logger.warning(
+                "context_parallel_size %d requested but the model spec "
+                "has no context_parallel_model hook; running without "
+                "sequence parallelism", self._context_parallel_size,
+            )
+            self._context_parallel_size = 1
+        if self._context_parallel_size > 1:
+            if self._pipeline_stages > 1:
+                raise ValueError(
+                    "context_parallel_size and pipeline_stages cannot "
+                    "be combined (no model spec stages a "
+                    "sequence-parallel attention); pick one"
+                )
+            if zero1:
+                logger.warning(
+                    "zero1 is ignored under sequence parallelism (the "
+                    "seq axis occupies the intra-process device slice "
+                    "the zero axis would use)"
+                )
+                zero1 = False
+            if quantized_grads:
+                logger.warning(
+                    "quantized_grads is ignored under sequence "
+                    "parallelism (the SP attention runs its own "
+                    "shard_map, which does not nest inside the "
+                    "quantized data-axis step yet)"
+                )
+                quantized_grads = False
         # Cross-replica weight-update sharding (ZeRO-1, parallel/zero1.py):
         # optimizer state shards over the data axis (single process) or the
         # intra-process "zero" axis (multi-host — see the module docstring's
@@ -173,15 +223,13 @@ class AllReduceTrainer(JaxTrainer):
         # legs) instead of XLA's f32 collective. On a {data, zero} mesh
         # only the cross-process data leg quantizes — the intra-host zero
         # reduction stays exact f32 on ICI, which is precisely the
-        # EQuARX deployment shape (quantize DCN, not ICI). Ignored under
-        # TP (grads there are sharded by layout, not replicated).
+        # EQuARX deployment shape (quantize DCN, not ICI). Composes with
+        # TP: shard_map goes manual over the data axis ONLY, the model
+        # axis stays automatic so GSPMD keeps the exact Megatron
+        # collectives while the data-axis mean of the model-sharded grads
+        # quantizes (_quantized_step_fn, TP variant) — the flagship's multi-host
+        # DP x intra-host TP shape quantizes exactly its DCN leg.
         self._quantized_grads = bool(quantized_grads)
-        if quantized_grads and self._model_parallel_size > 1:
-            logger.warning(
-                "quantized_grads is ignored when tensor parallelism is "
-                "active (TP gradients follow the param layout; there is "
-                "no whole-tree DP allreduce to quantize)"
-            )
         self._step_rng_base = jax.random.fold_in(
             jax.random.PRNGKey(seed), 0x5EED
         )
@@ -314,6 +362,7 @@ class AllReduceTrainer(JaxTrainer):
         self._sharded_steps = {}
         self._local_forward = None  # compiled against the torn-down backend
         self._rebuild_pipeline_build()
+        self._rebind_sp_model()
         if self._multi_host and jax.process_count() > 1:
             # SPMD world: sync state through an on-mesh collective that
             # EVERY member executes right after the rendezvous, instead of
@@ -479,7 +528,6 @@ class AllReduceTrainer(JaxTrainer):
     # ---------- mesh / sharding layout ----------
 
     def _make_world_mesh(self):
-        mp = self._model_parallel_size
         n = len(jax.devices())
         local_n = jax.local_device_count()
         multi_proc = jax.process_count() > 1
@@ -514,58 +562,22 @@ class AllReduceTrainer(JaxTrainer):
             else:
                 return make_mesh({DATA_AXIS: -1, STAGE_AXIS: pp})
             return make_mesh()
-        if mp > 1 and self._param_specs_fn is None:
-            # A model axis without param layouts would just duplicate the
-            # same DP computation mp times — half (or worse) of the
-            # cluster doing redundant work. Take the DP fallback instead.
-            logger.warning(
-                "model_parallel_size %d requested but the model spec has "
-                "no param_specs hook; falling back to pure data "
-                "parallelism", mp,
-            )
-        elif mp > 1 and n % mp != 0:
-            logger.warning(
-                "model_parallel_size %d does not divide %d devices; "
-                "falling back to pure data parallelism for this world",
-                mp, n,
-            )
-        elif mp > 1 and multi_proc and local_n % mp != 0:
-            # Composition invariant (module docstring): the model axis must
-            # stay inside one process so params remain fully addressable
-            # for regroup snapshots (and TP collectives stay on-host ICI).
-            logger.warning(
-                "model_parallel_size %d does not divide the %d local "
-                "devices of each process; multi-host TP requires an "
-                "intra-process model axis — falling back to pure data "
-                "parallelism for this world", mp, local_n,
-            )
-        elif mp > 1:
-            bad = (
-                self._spec_violations(self._variables, mp)
-                if self._variables is not None
-                else []
-            )
-            if bad:
-                # Keeping a (data=n/mp, model=mp) mesh with replicated
-                # params would silently run mp-way duplicated compute;
-                # rebuild a genuine pure-DP mesh instead.
-                logger.warning(
-                    "param_specs incompatible with model_parallel_size "
-                    "%d (%s); falling back to pure data parallelism",
-                    mp, "; ".join(bad[:3]),
-                )
-            elif multi_proc:
+        mp = self._tp_feasible(n, local_n, multi_proc)
+        sp = self._sp_feasible(n, local_n, multi_proc, mp)
+        if mp > 1 or sp > 1:
+            axes = {DATA_AXIS: -1}
+            if mp > 1:
+                axes[MODEL_AXIS] = mp
+            if sp > 1:
+                axes[SEQ_AXIS] = sp
+            if multi_proc:
                 # Explicit process-grouped device order: the flat reshape
-                # (data, model) then slices each length-mp model group out
-                # of ONE process's devices (local_n % mp == 0 checked
-                # above). mesh_utils reordering could break that, so the
-                # explicit device list skips it.
-                return make_mesh(
-                    {DATA_AXIS: -1, MODEL_AXIS: mp},
-                    devices=process_grouped_devices(),
-                )
-            else:
-                return make_mesh({DATA_AXIS: -1, MODEL_AXIS: mp})
+                # (data, model, seq) slices each trailing-axes group out
+                # of ONE process's devices (divisibility checked by the
+                # feasibility helpers). mesh_utils reordering could break
+                # that, so the explicit device list skips it.
+                return make_mesh(axes, devices=process_grouped_devices())
+            return make_mesh(axes)
         if self._zero1 and multi_proc and local_n > 1:
             # Factor pure DP into (data across processes, zero within):
             # the batch shards over both axes; optimizer state shards over
@@ -577,6 +589,88 @@ class AllReduceTrainer(JaxTrainer):
                 devices=process_grouped_devices(),
             )
         return make_mesh()
+
+    def _tp_feasible(self, n, local_n, multi_proc):
+        """The effective model-parallel width for this world: the
+        configured size when every precondition holds, else 1 (with a
+        warning naming the failed one) so the mesh degrades to DP instead
+        of silently duplicating compute over a model axis."""
+        mp = self._model_parallel_size
+        if mp <= 1:
+            return 1
+        if self._param_specs_fn is None:
+            # A model axis without param layouts would just duplicate the
+            # same DP computation mp times — half (or worse) of the
+            # cluster doing redundant work. Take the DP fallback instead.
+            logger.warning(
+                "model_parallel_size %d requested but the model spec has "
+                "no param_specs hook; falling back to pure data "
+                "parallelism", mp,
+            )
+            return 1
+        if n % mp != 0:
+            logger.warning(
+                "model_parallel_size %d does not divide %d devices; "
+                "falling back to pure data parallelism for this world",
+                mp, n,
+            )
+            return 1
+        if multi_proc and local_n % mp != 0:
+            # Composition invariant (module docstring): the model axis
+            # must stay inside one process so params remain fully
+            # addressable for regroup snapshots (and TP collectives stay
+            # on-host ICI).
+            logger.warning(
+                "model_parallel_size %d does not divide the %d local "
+                "devices of each process; multi-host TP requires an "
+                "intra-process model axis — falling back to pure data "
+                "parallelism for this world", mp, local_n,
+            )
+            return 1
+        bad = (
+            self._spec_violations(self._variables, mp)
+            if self._variables is not None
+            else []
+        )
+        if bad:
+            # Keeping a (data=n/mp, model=mp) mesh with replicated
+            # params would silently run mp-way duplicated compute;
+            # rebuild a genuine pure-DP mesh instead.
+            logger.warning(
+                "param_specs incompatible with model_parallel_size "
+                "%d (%s); falling back to pure data parallelism",
+                mp, "; ".join(bad[:3]),
+            )
+            return 1
+        return mp
+
+    def _sp_feasible(self, n, local_n, multi_proc, mp_eff):
+        """The effective sequence-parallel width: the configured size
+        when the combined trailing axes (model x seq) divide the device
+        counts, else 1 — the seq axis drops first, keeping any feasible
+        TP (the plain model trains identically without SP; TP needs its
+        param layout)."""
+        sp = self._context_parallel_size
+        if sp <= 1:
+            return 1
+        trailing = mp_eff * sp
+        if n % trailing != 0:
+            logger.warning(
+                "context_parallel_size %d (x model_parallel %d) does "
+                "not divide %d devices; running without sequence "
+                "parallelism for this world", sp, mp_eff, n,
+            )
+            return 1
+        if multi_proc and local_n % trailing != 0:
+            logger.warning(
+                "context_parallel_size %d (x model_parallel %d) does "
+                "not divide the %d local devices of each process; "
+                "multi-host SP requires intra-process model/seq axes — "
+                "running without sequence parallelism for this world",
+                sp, mp_eff, local_n,
+            )
+            return 1
+        return sp
 
     def _spec_violations(self, variables, mp):
         """Sharded dims that don't divide the model-axis size, as human
@@ -667,6 +761,46 @@ class AllReduceTrainer(JaxTrainer):
             and STAGE_AXIS in self._mesh.shape
             and self._mesh.shape[STAGE_AXIS] > 1
         )
+
+    def _sp_active(self):
+        return (
+            self._sp_model is not None
+            and SEQ_AXIS in self._mesh.shape
+            and self._mesh.shape[SEQ_AXIS] > 1
+        )
+
+    def _rebind_sp_model(self):
+        """(Re)bind the model spec's context_parallel_model hook to the
+        current mesh's seq axis. Only the TRAIN step uses the bound
+        model; init/eval/export keep self._model — same param tree, no
+        sharding constraints on arbitrary eval batch shapes."""
+        self._sp_model = None
+        if (
+            self._context_parallel_size <= 1
+            or self._context_parallel_model_fn is None
+            or SEQ_AXIS not in self._mesh.shape
+            or self._mesh.shape[SEQ_AXIS] <= 1
+        ):
+            return
+        head_axis = MODEL_AXIS if self._tp_active() else None
+        try:
+            self._sp_model = self._context_parallel_model_fn(
+                mesh=self._mesh,
+                axis_name=SEQ_AXIS,
+                batch_axis=DATA_AXIS,
+                head_axis=head_axis,
+                impl=self._context_parallel_impl,
+            )
+        except ValueError as e:
+            logger.warning(
+                "context_parallel_model hook rejected the configuration "
+                "(%s); running without sequence parallelism — rebuilding "
+                "a mesh without the seq axis", e,
+            )
+            self._context_parallel_size = 1
+            self._mesh = self._make_world_mesh()
+            self._sharded_steps = {}
+            logger.info("Mesh axes: %s", dict(self._mesh.shape))
 
     def _rebuild_pipeline_build(self):
         """(Re)bind the model spec's pipeline_spec hook to the current
@@ -764,13 +898,18 @@ class AllReduceTrainer(JaxTrainer):
 
             if self._pipeline_build is not None:
                 step_fn = self._pipeline_step_fn()
-            elif self._quantized_grads and not self._tp_active():
+            elif self._quantized_grads:
                 step_fn = self._quantized_step_fn()
             else:
+                # Sequence parallelism trains through the mesh-bound
+                # attention variant; identical param tree, so everything
+                # else (shardings, state, eval) is unchanged.
+                model = self._sp_model if self._sp_active() else None
+
                 def step_fn(variables, opt_state, rng, features, labels):
                     return self._step_body(
                         variables, opt_state, rng, features, labels,
-                        slice_to,
+                        slice_to, model=model,
                     )
 
             # No buffer donation here (unlike the local trainer): a comm
@@ -801,27 +940,45 @@ class AllReduceTrainer(JaxTrainer):
         return step
 
     def _quantized_step_fn(self):
-        """DP step with the data-axis gradient reduction quantized to int8
-        (EQuARX-style — see the constructor comment). shard_map computes
-        per-shard grads from the local batch rows, reduces them exactly
-        over any intra-host "zero" axis, then through quantized_pmean
-        over "data"; the optimizer update runs outside on the replicated
-        result (so it composes with ZeRO-1's sharded opt state — GSPMD
-        shards the update math and all-gathers the params). No slice_to:
-        the loss is over the whole padded batch, same semantics as the
-        multi-host path documented in _sharded_step_for."""
+        """Step with the data-axis gradient reduction quantized to int8
+        (EQuARX-style — see the constructor comment). Two deployments,
+        one body:
+
+        - Pure DP (possibly factored {data, zero}): shard_map manual over
+          every batch axis; any intra-host zero leg reduces exact f32 on
+          ICI first, then quantized_pmean over "data" — so on multi-host
+          meshes only the cross-process leg quantizes.
+        - DP x TP: shard_map goes manual over the DATA axis ONLY
+          (jax.shard_map axis_names, EQuARX's own deployment doctrine:
+          quantize the slow leg, keep the fast one exact). The model axis
+          stays AUTOMATIC, so GSPMD keeps inserting the exact Megatron
+          collectives inside each data shard's forward/backward — TP
+          activations ride intra-host ICI in f32 — while the cross-shard
+          gradient mean (the DCN leg in the flagship's multi-host DP x
+          intra-host TP north star) goes through quantized_pmean's int8
+          wire.
+
+        Either way the optimizer update runs outside on the reduced
+        grads, composing with ZeRO-1's sharded opt state (GSPMD shards
+        the update math and all-gathers the params) or resharding to
+        mirror the TP param layout. No slice_to: the loss is over the
+        whole padded batch, same semantics as the multi-host path
+        documented in _sharded_step_for."""
         import optax
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
         from elasticdl_tpu.parallel.quantized import quantized_pmean
 
-        axes = batch_axes(self._mesh)
         mesh = self._mesh
+        tp = self._tp_active()
+        axes = (DATA_AXIS,) if tp else batch_axes(mesh)
+        sm_kwargs = {"axis_names": {DATA_AXIS}} if tp else {}
 
         def shard_fn(params, state, rng, features, labels):
-            # Decorrelate dropout across shards (each shard holds
-            # different rows); fold_in keeps it deterministic.
+            # Decorrelate dropout across batch shards only (each holds
+            # different rows); under TP the model shards hold the SAME
+            # rows and must draw identical masks, which the auto model
+            # axis keeps consistent by construction.
             idx = jax.lax.axis_index(axes)
             rng = jax.random.fold_in(rng, idx)
             loss, grads, new_state = self._apply_train(
@@ -830,7 +987,7 @@ class AllReduceTrainer(JaxTrainer):
             if ZERO_AXIS in axes:
                 # Intra-host leg stays exact f32 on ICI.
                 grads = jax.lax.pmean(grads, ZERO_AXIS)
-            grads = quantized_pmean(grads, "data")
+            grads = quantized_pmean(grads, DATA_AXIS)
             loss = jax.lax.pmean(loss, axes)
             if new_state:
                 new_state = jax.lax.pmean(new_state, axes)
@@ -839,12 +996,13 @@ class AllReduceTrainer(JaxTrainer):
         def step_fn(variables, opt_state, rng, features, labels):
             params = variables["params"]
             state = {k: v for k, v in variables.items() if k != "params"}
-            loss, grads, new_state = shard_map(
+            loss, grads, new_state = jax.shard_map(
                 shard_fn,
                 mesh=mesh,
                 in_specs=(P(), P(), P(), P(axes), P(axes)),
                 out_specs=(P(), P(), P()),
                 check_vma=False,
+                **sm_kwargs,
             )(params, state, rng, features, labels)
             updates, new_opt_state = self._optax.update(
                 grads, opt_state, params

@@ -69,6 +69,11 @@ def build_trainer(args, spec, master_client):
             pipeline_microbatches=args.pipeline_microbatches,
             pipeline_virtual_stages=args.pipeline_virtual_stages,
             pipeline_spec_fn=getattr(spec.module, "pipeline_spec", None),
+            context_parallel_size=args.context_parallel_size,
+            context_parallel_impl=args.context_parallel_impl,
+            context_parallel_model_fn=getattr(
+                spec.module, "context_parallel_model", None
+            ),
         )
     from elasticdl_tpu.worker.trainer import LocalTrainer
 

@@ -116,14 +116,18 @@ class JaxTrainer(Trainer):
     # ---------- step functions ----------
 
     def _apply_train(self, params, state, rng, features, labels,
-                     slice_to=None):
+                     slice_to=None, model=None):
         """Pure fwd+bwd; the body every strategy shares. slice_to trims
         padding rows off outputs/labels before the loss (used by sharded
-        strategies that pad batches to the mesh size)."""
+        strategies that pad batches to the mesh size). `model` overrides
+        self._model for strategies that train through a mesh-bound
+        variant of the same architecture (e.g. ring-attention SP) whose
+        param tree is identical."""
         mutable = [k for k in state]
+        model = model if model is not None else self._model
 
         def loss_of(p):
-            out = self._model.apply(
+            out = model.apply(
                 {"params": p, **state},
                 features,
                 training=True,
@@ -158,13 +162,13 @@ class JaxTrainer(Trainer):
         return loss, grads, new_state
 
     def _step_body(self, variables, opt_state, rng, features, labels,
-                   slice_to=None):
+                   slice_to=None, model=None):
         """fwd + bwd + optimizer update; shared by every on-device-update
         strategy (local and AllReduce)."""
         params = variables["params"]
         state = {k: v for k, v in variables.items() if k != "params"}
         loss, grads, new_state = self._apply_train(
-            params, state, rng, features, labels, slice_to
+            params, state, rng, features, labels, slice_to, model=model
         )
         updates, new_opt_state = self._optax.update(
             grads, opt_state, params

@@ -115,6 +115,16 @@ def test_kill_worker_mid_job_drill(tmp_path, strategy, num_ps):
             {},
             "'data': 8",
         ),
+        # DP x TP x QUANTIZED across processes: the flagship north-star
+        # composition (multi-host data axis, intra-host model axis) with
+        # the cross-process gradient mean quantized — the exact DCN leg
+        # EQuARX targets — surviving a SIGKILL regroup.
+        (
+            "dp_tp_quantized",
+            ("--model_parallel_size", "2", "--quantized_grads"),
+            {},
+            "'model': 2",
+        ),
         # DP x PIPELINE across processes: the stage axis (2) lives inside
         # each 4-device process (same composition invariant as dp_tp),
         # microbatches flow through the GPipe schedule, and the staged
