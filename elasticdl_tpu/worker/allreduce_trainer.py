@@ -182,6 +182,12 @@ class AllReduceTrainer(JaxTrainer):
                 "sequence parallelism", self._context_parallel_size,
             )
             self._context_parallel_size = 1
+        # Per-world downgrade bit: a hook rejection that depends on the
+        # CURRENT mesh (e.g. ulysses under an active TP head axis) drops
+        # the seq axis for that world only — the next world change
+        # retries (unlike the pipeline hook, whose rejections are
+        # config-determined and permanent).
+        self._sp_suspend_once = False
         if self._context_parallel_size > 1:
             if self._pipeline_stages > 1:
                 raise ValueError(
@@ -189,21 +195,23 @@ class AllReduceTrainer(JaxTrainer):
                     "be combined (no model spec stages a "
                     "sequence-parallel attention); pick one"
                 )
+            # zero1/quantized_grads are SUSPENDED while the seq axis is
+            # active (the SP attention runs its own shard_map, which
+            # neither the quantized data-axis step nor the zero-axis
+            # factoring nests with yet) — not zeroed: a world where SP
+            # drops (indivisible devices) gets them back.
             if zero1:
                 logger.warning(
-                    "zero1 is ignored under sequence parallelism (the "
-                    "seq axis occupies the intra-process device slice "
-                    "the zero axis would use)"
+                    "zero1 is suspended while the seq axis is active; "
+                    "it applies again in worlds that cannot host "
+                    "sequence parallelism"
                 )
-                zero1 = False
             if quantized_grads:
                 logger.warning(
-                    "quantized_grads is ignored under sequence "
-                    "parallelism (the SP attention runs its own "
-                    "shard_map, which does not nest inside the "
-                    "quantized data-axis step yet)"
+                    "quantized_grads is suspended while the seq axis "
+                    "is active; it applies again in worlds that cannot "
+                    "host sequence parallelism"
                 )
-                quantized_grads = False
         # Cross-replica weight-update sharding (ZeRO-1, parallel/zero1.py):
         # optimizer state shards over the data axis (single process) or the
         # intra-process "zero" axis (multi-host — see the module docstring's
@@ -651,7 +659,7 @@ class AllReduceTrainer(JaxTrainer):
         TP (the plain model trains identically without SP; TP needs its
         param layout)."""
         sp = self._context_parallel_size
-        if sp <= 1:
+        if sp <= 1 or self._sp_suspend_once:
             return 1
         trailing = mp_eff * sp
         if n % trailing != 0:
@@ -717,7 +725,7 @@ class AllReduceTrainer(JaxTrainer):
         multi-host one — replicated otherwise (under TP the initial
         replication is resharded by GSPMD to mirror the param layout
         after the first step)."""
-        if self._zero1 and not self._tp_active():
+        if self._zero1 and not self._tp_active() and not self._sp_active():
             from elasticdl_tpu.parallel.zero1 import (
                 weight_update_shardings,
             )
@@ -792,13 +800,20 @@ class AllReduceTrainer(JaxTrainer):
                 impl=self._context_parallel_impl,
             )
         except ValueError as e:
+            # World-scoped, not permanent: the rejection can depend on
+            # this mesh (head_axis only exists when TP is active here);
+            # the next world change retries the hook fresh.
             logger.warning(
-                "context_parallel_model hook rejected the configuration "
-                "(%s); running without sequence parallelism — rebuilding "
-                "a mesh without the seq axis", e,
+                "context_parallel_model hook rejected this world's "
+                "configuration (%s); running without sequence "
+                "parallelism for this world — rebuilding a mesh "
+                "without the seq axis", e,
             )
-            self._context_parallel_size = 1
-            self._mesh = self._make_world_mesh()
+            self._sp_suspend_once = True
+            try:
+                self._mesh = self._make_world_mesh()
+            finally:
+                self._sp_suspend_once = False
             self._sharded_steps = {}
             logger.info("Mesh axes: %s", dict(self._mesh.shape))
 
@@ -898,18 +913,27 @@ class AllReduceTrainer(JaxTrainer):
 
             if self._pipeline_build is not None:
                 step_fn = self._pipeline_step_fn()
-            elif self._quantized_grads:
-                step_fn = self._quantized_step_fn()
-            else:
+            elif self._sp_active():
                 # Sequence parallelism trains through the mesh-bound
                 # attention variant; identical param tree, so everything
-                # else (shardings, state, eval) is unchanged.
-                model = self._sp_model if self._sp_active() else None
+                # else (shardings, state, eval) is unchanged. Quantized
+                # grads stay suspended on SP worlds (see __init__).
+                model = self._sp_model
 
                 def step_fn(variables, opt_state, rng, features, labels):
                     return self._step_body(
                         variables, opt_state, rng, features, labels,
                         slice_to, model=model,
+                    )
+
+            elif self._quantized_grads:
+                step_fn = self._quantized_step_fn()
+            else:
+
+                def step_fn(variables, opt_state, rng, features, labels):
+                    return self._step_body(
+                        variables, opt_state, rng, features, labels,
+                        slice_to,
                     )
 
             # No buffer donation here (unlike the local trainer): a comm

@@ -26,7 +26,10 @@ class PSClient:
         PS is, so this is the EQuARX-analog lever for the PS strategy."""
         if wire_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"unsupported wire_dtype {wire_dtype!r}")
-        self._bf16_wire = wire_dtype == "bfloat16"
+        # Public: the trainer keys its device-side dtype plumbing off
+        # the wire dtype (bf16 rows/grads stay bf16 across the
+        # host<->device hop too).
+        self.bf16_wire = wire_dtype == "bfloat16"
         self._addrs = list(ps_addrs)
         self._worker_id = worker_id
         self._channels = [rpc.build_channel(a) for a in self._addrs]
@@ -127,14 +130,21 @@ class PSClient:
                     params[t.name] = tensor_utils.tensor_pb_to_ndarray(t)
         return initialized, max_version, params
 
-    def pull_embedding_vectors(self, name, ids):
+    def pull_embedding_vectors(self, name, ids, keep_wire_dtype=False):
         """ids [k] -> [k, dim] rows, gathered across shards by id modulo and
-        restored to input order."""
+        restored to input order.
+
+        keep_wire_dtype=True hands bf16-wire rows back AS bf16 instead of
+        widening to f32 on the host: bf16 -> f32 is exact, so a caller
+        that uploads the rows to a device (the PS trainer's prefetch) can
+        defer the widening to the chip and move half the bytes across the
+        host->device hop — which on tunnel-attached chips is the
+        prefetch phase's actual limiter (tools/ps_push_probe.py)."""
         ids = np.asarray(ids, dtype=np.int64)
         if ids.size == 0:
             return None
         scattered = hash_utils.scatter_embedding_ids(ids, self.num_ps)
-        value_dtype = pb.DT_BFLOAT16 if self._bf16_wire else pb.DT_INVALID
+        value_dtype = pb.DT_BFLOAT16 if self.bf16_wire else pb.DT_INVALID
         futures = {
             ps_id: (
                 positions,
@@ -151,7 +161,7 @@ class PSClient:
         out = None
         for ps_id, (positions, f) in futures.items():
             values = tensor_utils.tensor_pb_to_ndarray(f.result())
-            if values.dtype != np.float32:
+            if values.dtype != np.float32 and not keep_wire_dtype:
                 values = values.astype(np.float32)
             if out is None:
                 out = np.empty(
@@ -230,7 +240,7 @@ class PSClient:
                 np.asarray(values, dtype=np.float32),
                 np.asarray(ids, dtype=np.int64),
             )
-            if self._bf16_wire:
+            if self.bf16_wire:
                 values = values.astype(tensor_utils.bfloat16)
             for ps_id, (shard_ids, positions) in (
                 hash_utils.scatter_embedding_ids(ids, self.num_ps).items()

@@ -58,6 +58,19 @@ class ParameterServerTrainer(JaxTrainer):
     ):
         super().__init__(model, loss_fn, optimizer_spec, seed=seed)
         self._ps = ps_client
+        # bf16 wire dtype extends ACROSS the host<->device hop, not just
+        # the TCP wire: prefetched rows upload as bf16 (widened to f32 on
+        # the chip — exact) and the jitted step hands embedding grads
+        # back as bf16 (the cast runs on device), so both transfer legs
+        # move half the bytes. On tunnel-attached chips those hops are
+        # the PS step's measured limiter (tools/ps_push_probe.py: d2h
+        # ~38 MB/s vs a 0.25 s host-side floor); on PCIe-attached chips
+        # the halving still frees host memcpy/serialize time. Precision:
+        # rows already crossed the wire in bf16 (no new loss); grads
+        # round to bf16 before the client's f32 dedup-sum instead of
+        # after — the same order the wire cast imposes on single-
+        # occurrence ids, now uniform for duplicates too.
+        self._bf16_wire = bool(getattr(ps_client, "bf16_wire", False))
         # Pipelined pushes (async SGD only): the gradient device_get +
         # partition + RPC runs on a background thread while this thread
         # pulls/prefetches the NEXT batch — so the per-step critical path
@@ -268,7 +281,11 @@ class ParameterServerTrainer(JaxTrainer):
         for table, ids in self._embedding_inputs(features).items():
             ids = np.asarray(ids, dtype=np.int64).reshape(-1)
             unique, inverse = np.unique(ids, return_inverse=True)
-            pulled = self._ps.pull_embedding_vectors(table, unique)
+            # bf16 wire: upload the rows AS bf16 and widen on the chip
+            # (exact) — half the bytes across the host->device hop.
+            pulled = self._ps.pull_embedding_vectors(
+                table, unique, keep_wire_dtype=self._bf16_wire
+            )
             by_path[self._embedding_paths[table]] = jnp.asarray(
                 pulled[inverse]
             )
@@ -277,12 +294,25 @@ class ParameterServerTrainer(JaxTrainer):
 
     # ---------- jitted steps ----------
 
+    def _widen_rows(self, rows):
+        """bf16-uploaded rows -> f32 on the chip (exact; the model's
+        embedding math stays f32 regardless of the wire dtype)."""
+        if not self._bf16_wire:
+            return rows
+        return jax.tree_util.tree_map(
+            lambda r: r.astype(jnp.float32), rows
+        )
+
     def _build_ps_step(self):
         def step(params, state, emb_rows, rng, features, labels):
             def loss_of(p, rows):
                 mutable = [k for k in state]
                 out = self._model.apply(
-                    {"params": p, **state, EMBEDDING_COLLECTION: rows},
+                    {
+                        "params": p,
+                        **state,
+                        EMBEDDING_COLLECTION: self._widen_rows(rows),
+                    },
                     features,
                     training=True,
                     rngs={"dropout": rng},
@@ -291,6 +321,9 @@ class ParameterServerTrainer(JaxTrainer):
                 outputs, new_state = out if mutable else (out, state)
                 return self._loss_fn(labels, outputs), new_state
 
+            # Differentiating through the bf16->f32 widen makes the row
+            # cotangents come out bf16 automatically: the device casts,
+            # and device_get in the push moves half the bytes.
             (loss, new_state), grads = jax.value_and_grad(
                 loss_of, argnums=(0, 1), has_aux=True
             )(params, emb_rows)
@@ -301,7 +334,11 @@ class ParameterServerTrainer(JaxTrainer):
     def _build_ps_forward(self):
         def forward(params, state, emb_rows, features):
             return self._model.apply(
-                {"params": params, **state, EMBEDDING_COLLECTION: emb_rows},
+                {
+                    "params": params,
+                    **state,
+                    EMBEDDING_COLLECTION: self._widen_rows(emb_rows),
+                },
                 features,
                 training=False,
             )
