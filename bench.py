@@ -293,14 +293,26 @@ def bench_deepfm_ps(batch_size=16384, steps=6, warmup=4, num_ps=2,
         )
     out["loadavg_end"] = os.getloadavg()[0]
     if out.get("serialized", {}).get("examples_per_sec"):
-        out["overlap_speedup"] = (
-            out["pipelined"]["examples_per_sec"]
-            / out["serialized"]["examples_per_sec"]
+        # Derived ratios inherit contamination: a gate-flagged median
+        # must not silently feed a clean-looking headline speedup.
+        def ratio(num, den):
+            value = (
+                out[num]["examples_per_sec"]
+                / out[den]["examples_per_sec"]
+            )
+            flagged = any(
+                out[c].get("spread_exceeds_gate") for c in (num, den)
+            )
+            return value, flagged
+
+        out["overlap_speedup"], flagged = ratio("pipelined", "serialized")
+        if flagged:
+            out["overlap_speedup_contaminated"] = True
+        out["bf16_wire_speedup"], flagged = ratio(
+            "serialized_bf16_wire", "serialized"
         )
-        out["bf16_wire_speedup"] = (
-            out["serialized_bf16_wire"]["examples_per_sec"]
-            / out["serialized"]["examples_per_sec"]
-        )
+        if flagged:
+            out["bf16_wire_speedup_contaminated"] = True
     return out
 
 

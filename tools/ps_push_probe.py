@@ -74,7 +74,7 @@ def _timeit(fn, repeats=5):
     return best
 
 
-def build_shard_requests(ids, sparse, dense):
+def build_shard_requests(ids, sparse, dense, batch=16384):
     """Mirror PSClient.push_gradients: dedup, scatter, pb-encode."""
     shard_models = {
         ps: pb.Model(version=1) for ps in range(NUM_PS)
@@ -96,7 +96,7 @@ def build_shard_requests(ids, sparse, dense):
             )
     return {
         ps: pb.PushGradientsRequest(
-            gradients=m, worker_id_plus_one=1, batch_size=16384
+            gradients=m, worker_id_plus_one=1, batch_size=batch
         )
         for ps, m in shard_models.items()
     }
@@ -259,9 +259,9 @@ def main():
 
     # 1. client prep.
     out["client_prep_s"] = _timeit(
-        lambda: build_shard_requests(ids, sparse, dense)
+        lambda: build_shard_requests(ids, sparse, dense, args.batch)
     )
-    requests = build_shard_requests(ids, sparse, dense)
+    requests = build_shard_requests(ids, sparse, dense, args.batch)
 
     # 2. wire bytes.
     sizes = {ps: req.ByteSize() for ps, req in requests.items()}
