@@ -351,15 +351,10 @@ def test_train_flagship_lm_1f1b_pipeline(tmp_path):
     worker/main.py — pipeline parallelism reachable by a real job, not
     just the library tests. Data: deterministic successor sequences
     (token[t+1] = token[t] + 1 mod vocab), trivially learnable."""
-    from elasticdl_tpu.data.example import encode_example
+    from test_utils import write_lm_records
 
-    rng = np.random.default_rng(0)
     data = str(tmp_path / "lm.edlr")
-    with RecordFileWriter(data) as w:
-        for _ in range(128):
-            start = int(rng.integers(0, 256))
-            seq = (start + np.arange(33)) % 256
-            w.write(encode_example({"tokens": seq.astype(np.int32)}))
+    write_lm_records(data, n=128, seed=0)
     output = str(tmp_path / "lm.npz")
     res = run_edl(
         "train",
@@ -395,15 +390,10 @@ def test_train_flagship_lm_context_parallel_cli(tmp_path):
     """--context_parallel_size through the real CLI (VERDICT r4 #7): the
     worker builds a ("data", "seq") mesh and trains the flagship LM with
     zigzag ring attention bound to it."""
-    from elasticdl_tpu.data.example import encode_example
+    from test_utils import write_lm_records
 
-    rng = np.random.default_rng(1)
     data = str(tmp_path / "lm.edlr")
-    with RecordFileWriter(data) as w:
-        for _ in range(96):
-            start = int(rng.integers(0, 256))
-            seq = (start + np.arange(33)) % 256
-            w.write(encode_example({"tokens": seq.astype(np.int32)}))
+    write_lm_records(data, n=96, seed=1)
     res = run_edl(
         "train",
         "--model_def",
@@ -421,3 +411,31 @@ def test_train_flagship_lm_context_parallel_cli(tmp_path):
     )
     assert res.returncode == 0, res.stderr[-3000:]
     assert "'seq': 2" in res.stderr, res.stderr[-2000:]
+
+
+def test_train_moe_lm_expert_parallel_cli(tmp_path):
+    """Expert parallelism through the real CLI: the Switch-MoE LM's
+    param_specs shard expert weights over the 'model' axis, so
+    --model_parallel_size is the EP knob — a job really trains with
+    experts device-sharded (4 experts over a 2-wide axis)."""
+    from test_utils import write_lm_records
+
+    data = str(tmp_path / "lm.edlr")
+    write_lm_records(data, n=96, seed=2)
+    res = run_edl(
+        "train",
+        "--model_def",
+        "elasticdl_tpu.models.transformer.moe_lm",
+        "--training_data", data,
+        "--num_epochs", "1",
+        "--records_per_task", "32",
+        "--minibatch_size", "16",
+        "--num_workers", "1",
+        "--distribution_strategy", "AllreduceStrategy",
+        "--model_parallel_size", "2",
+        "--instance_backend", "local_process",
+        "--master_port", "0",
+        timeout=420,
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert "'model': 2" in res.stderr, res.stderr[-2000:]

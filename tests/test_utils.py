@@ -73,3 +73,19 @@ def run_edl(*argv, timeout=240, include_tests_on_path=True):
         env=env,
         cwd=repo,
     )
+
+
+def write_lm_records(path, n=96, seed=0, vocab=256, seq_plus_one=33):
+    """Synthetic successor-sequence LM records (token[t+1] = token[t]+1
+    mod vocab) shared by the LM CLI e2e tests."""
+    import numpy as np
+
+    from elasticdl_tpu.data.example import encode_example
+    from elasticdl_tpu.data.recordfile import RecordFileWriter
+
+    rng = np.random.default_rng(seed)
+    with RecordFileWriter(path) as w:
+        for _ in range(n):
+            start = int(rng.integers(0, vocab))
+            seq = (start + np.arange(seq_plus_one)) % vocab
+            w.write(encode_example({"tokens": seq.astype(np.int32)}))
