@@ -152,6 +152,31 @@ def bench_deepfm_criteo(batch_size=32768, steps=30, warmup=5):
     }
 
 
+def _device_transfer_mb_per_s(mb=8):
+    """One d2h round of `mb` MB: the PS bench's measured limiter on
+    tunnel-attached chips (PERF_SNAPSHOT ps_push_decomposition). Recorded
+    as session context so a flagged/slow PS result can be attributed to
+    the environment; None off-device."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        if jax.default_backend() == "cpu":
+            return None
+        n = mb * (1 << 20) // 4
+        best = float("inf")
+        for i in range(2):
+            x = jax.block_until_ready(
+                jnp.ones((n,), jnp.float32) * (i + 1)
+            )
+            t0 = time.perf_counter()
+            np.asarray(x)  # forced host materialization
+            best = min(best, time.perf_counter() - t0)
+        return round(mb / best, 1)
+    except Exception:
+        return None
+
+
 def aggregate_runs(runs, spread_gate=1.25, key="examples_per_sec"):
     """Median-of-N reporting with an explicit outlier flag (VERDICT r4
     #2): the headline is the median run's rate, the reported phase
@@ -285,12 +310,24 @@ def bench_deepfm_ps(batch_size=16384, steps=6, warmup=4, num_ps=2,
         "median_of_n": repeats,
         "spread_gate": spread_gate,
         "loadavg_start": os.getloadavg()[0],
+        # Context for flagged runs: this bench's limiter is the
+        # host<->device hop, and on tunnel-attached chips its bandwidth
+        # fluctuates session to session — record it like loadavg.
+        "device_transfer_mb_per_s": _device_transfer_mb_per_s(),
     }
     for name, pipelined, wire in configs:
-        out[name] = aggregate_runs(
-            [run_once(pipelined, wire) for _ in range(repeats)],
-            spread_gate,
-        )
+        runs = [run_once(pipelined, wire) for _ in range(repeats)]
+        agg = aggregate_runs(runs, spread_gate)
+        if agg.get("spread_exceeds_gate"):
+            # More samples, same estimator: a transient host/tunnel spike
+            # in a 3-run session can leave the median itself suspect; two
+            # extra runs make it robust while the full (5-run) list and
+            # spread stay recorded. Not best-of — the median is over ALL
+            # runs.
+            runs += [run_once(pipelined, wire) for _ in range(2)]
+            agg = aggregate_runs(runs, spread_gate)
+            agg["extended_to_n"] = len(runs)
+        out[name] = agg
     out["loadavg_end"] = os.getloadavg()[0]
     if out.get("serialized", {}).get("examples_per_sec"):
         # Derived ratios inherit contamination: a gate-flagged median
