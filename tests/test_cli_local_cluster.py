@@ -439,3 +439,46 @@ def test_train_moe_lm_expert_parallel_cli(tmp_path):
     )
     assert res.returncode == 0, res.stderr[-3000:]
     assert "'model': 2" in res.stderr, res.stderr[-2000:]
+
+
+def test_multihost_two_workers_pipeline_1f1b(tmp_path):
+    """TWO worker processes form one SPMD world and train the flagship LM
+    through the 1F1B pipeline schedule: {data: 2 procs, stage: 2 intra-
+    process} — the full multi-host composition invariant for the stage
+    axis, through the real CLI and step-synchronized leases."""
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from elastic_drill import free_coordinator_block
+    from test_utils import write_lm_records
+
+    data = str(tmp_path / "lm.edlr")
+    write_lm_records(data, n=96, seed=3)
+    res = run_edl(
+        "train",
+        "--model_def",
+        "elasticdl_tpu.models.transformer.transformer_lm",
+        "--training_data", data,
+        "--num_epochs", "2",
+        "--records_per_task", "32",
+        "--minibatch_size", "16",
+        "--num_workers", "2",
+        "--distribution_strategy", "AllreduceStrategy",
+        "--multi_host",
+        "--coordinator_port", str(free_coordinator_block()),
+        "--pipeline_stages", "2",
+        "--pipeline_schedule", "1f1b",
+        "--pipeline_microbatches", "2",
+        "--instance_backend", "local_process",
+        "--master_port", "0",
+        timeout=420,
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert "Minted lease" in res.stderr
+    # The composed mesh really formed (stage axis intra-process; the
+    # data-axis size depends on the inherited per-process device count,
+    # so assert the invariant, not the number) in a genuine 2-process
+    # world.
+    assert "'stage': 2" in res.stderr, res.stderr[-2000:]
+    assert "world 2" in res.stderr
+    assert "Initialized pipelined model" in res.stderr
