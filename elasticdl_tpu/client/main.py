@@ -312,6 +312,14 @@ def _top(args):
                 )
             return 2
         errors = 0
+        if last_status is None and status.metrics_port:
+            # One-time pointer at the master's Prometheus endpoint (same
+            # host as the gRPC addr, different port).
+            host = args.master_addr.rsplit(":", 1)[0]
+            print(
+                f"metrics: http://{host}:{status.metrics_port}/metrics",
+                flush=True,
+            )
         last_status = status
         now = time.time()
         rate = ""
@@ -326,12 +334,21 @@ def _top(args):
                 for k, v in sorted(status.last_eval_metrics.items())
             )
             evals = f" eval@v{status.last_eval_version}[{shown}]"
+        # Elasticity counters from the observability plane: shown only
+        # once nonzero so a healthy job's line stays short.
+        elastic = ""
+        if status.relaunches:
+            elastic += f" relaunches={status.relaunches}"
+        if status.tasks_recovered:
+            elastic += f" recovered={status.tasks_recovered}"
+        if status.membership_epoch:
+            elastic += f" mepoch={status.membership_epoch}"
         print(
             f"epoch {status.epoch}/{status.num_epochs} "
             f"v{status.model_version} "
             f"tasks todo={status.todo_tasks} doing={status.doing_tasks} "
             f"workers={status.alive_workers} "
-            f"records={status.records_done}{rate}{evals}"
+            f"records={status.records_done}{rate}{elastic}{evals}"
             + (" FAILED" if status.job_failed else "")
             + (" FINISHED" if status.finished else ""),
             flush=True,

@@ -1,0 +1,25 @@
+"""Version shims over the moving parts of the JAX API.
+
+The repo targets current JAX (`jax.shard_map`, `check_vma=`), but the
+image may carry an older release where shard_map still lives in
+jax.experimental and the replication-check kwarg is `check_rep`. Import
+shard_map from here instead of from jax directly; call sites keep the
+modern spelling (`check_vma=`) and this shim down-translates when needed.
+"""
+
+try:
+    from jax import shard_map  # noqa: F401  (jax >= 0.6)
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        # New API selects the MANUAL axes (axis_names); the experimental
+        # signature selects the complement (auto = axes left automatic).
+        axis_names = kwargs.pop("axis_names", None)
+        if axis_names is not None:
+            kwargs["auto"] = frozenset(
+                kwargs["mesh"].axis_names
+            ) - frozenset(axis_names)
+        return _shard_map(f, **kwargs)

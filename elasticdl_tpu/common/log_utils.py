@@ -1,28 +1,90 @@
 """Logger factory with per-module levels (reference:
-/root/reference/elasticdl/python/common/log_utils.py:33)."""
+/root/reference/elasticdl/python/common/log_utils.py:33).
 
+Environment knobs (read once, at first get_logger; `configure(force=True)`
+re-reads them):
+
+  ELASTICDL_LOG_LEVEL    DEBUG/INFO/WARNING/ERROR (or a number); default INFO
+  ELASTICDL_LOG_FORMAT   "json" switches to one JSON object per line with
+                         job/pod identity, machine-parseable alongside the
+                         observability event log; anything else keeps the
+                         human format.
+
+Identity (job name, role) is stamped into JSON records; it comes from
+set_identity() (called by observability.setup) or the ELASTICDL_JOB_NAME /
+ELASTICDL_ROLE environment variables the master sets for spawned instances.
+"""
+
+import json
 import logging
+import os
 import sys
 
 _FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
 _configured = False
+_identity = {}
 
 
-def _configure_root():
+def set_identity(job="", role=""):
+    """Attach job/pod identity to subsequent JSON log records."""
+    if job:
+        _identity["job"] = job
+    if role:
+        _identity["role"] = role
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record):
+        out = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "line": f"{record.filename}:{record.lineno}",
+            "msg": record.getMessage(),
+        }
+        out.update(_identity)
+        if not _identity:
+            job = os.environ.get("ELASTICDL_JOB_NAME", "")
+            role = os.environ.get("ELASTICDL_ROLE", "")
+            if job:
+                out["job"] = job
+            if role:
+                out["role"] = role
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, separators=(",", ":"))
+
+
+def _resolve_level():
+    raw = os.environ.get("ELASTICDL_LOG_LEVEL", "").strip()
+    if not raw:
+        return logging.INFO
+    if raw.isdigit():
+        return int(raw)
+    return getattr(logging, raw.upper(), logging.INFO)
+
+
+def configure(force=False):
+    """(Re)configure the package root logger from the environment."""
     global _configured
-    if _configured:
+    if _configured and not force:
         return
-    handler = logging.StreamHandler(sys.stderr)
-    handler.setFormatter(logging.Formatter(_FORMAT))
     root = logging.getLogger("elasticdl_tpu")
+    for handler in list(root.handlers):
+        root.removeHandler(handler)
+    handler = logging.StreamHandler(sys.stderr)
+    if os.environ.get("ELASTICDL_LOG_FORMAT", "").lower() == "json":
+        handler.setFormatter(JsonFormatter())
+    else:
+        handler.setFormatter(logging.Formatter(_FORMAT))
     root.addHandler(handler)
     root.propagate = False
-    root.setLevel(logging.INFO)
+    root.setLevel(_resolve_level())
     _configured = True
 
 
 def get_logger(name: str, level=None) -> logging.Logger:
-    _configure_root()
+    configure()
     logger = logging.getLogger(f"elasticdl_tpu.{name}")
     if level is not None:
         logger.setLevel(level)

@@ -12,6 +12,7 @@ import dataclasses
 
 import grpc
 
+from elasticdl_tpu.observability import tracing
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
 # Matches the reference's 256 MB gRPC message cap
@@ -103,9 +104,14 @@ def add_servicer_to_server(servicer, spec: ServiceSpec, server: grpc.Server):
 
 
 def build_server(max_workers: int = 64) -> grpc.Server:
+    # The tracing interceptor propagates edl-trace-* metadata into each
+    # handler's context and records server spans once a recorder is
+    # configured (observability.setup); unconfigured it costs one dict
+    # lookup per RPC.
     return grpc.server(
         concurrent.futures.ThreadPoolExecutor(max_workers=max_workers),
         options=GRPC_CHANNEL_OPTIONS,
+        interceptors=(tracing.TracingServerInterceptor(),),
     )
 
 
@@ -121,4 +127,10 @@ def serve(servicer, spec: ServiceSpec, port: int = 0, max_workers: int = 64):
 
 
 def build_channel(addr: str) -> grpc.Channel:
-    return grpc.insecure_channel(addr, options=GRPC_CHANNEL_OPTIONS)
+    channel = grpc.insecure_channel(addr, options=GRPC_CHANNEL_OPTIONS)
+    # Trace-context injection rides every channel so one task's RPC chain
+    # (dispatch -> pull -> train -> push -> report) shares a trace id
+    # across processes.
+    return grpc.intercept_channel(
+        channel, tracing.TracingClientInterceptor()
+    )

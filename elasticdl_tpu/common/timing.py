@@ -3,14 +3,35 @@
 Reference counterpart: /root/reference/elasticdl/python/common/
 timing_utils.py:17-48 (named start/end wall-clock accumulators reported at
 task granularity under DEBUG) — redesigned as a context-manager API so a
-phase can't be left open, plus per-phase call counts and means, which is
-what a step-time breakdown (pull / step / push, the reference's published
-benchmark decomposition, docs/benchmark/ftlib_benchmark.md:119-124) needs.
+phase can't be left open, plus per-phase call counts, means, min/max and
+bounded-reservoir percentiles (p50/p99), which is what a step-time
+breakdown (pull / step / push, the reference's published benchmark
+decomposition, docs/benchmark/ftlib_benchmark.md:119-124) needs.
+
+A Timing can mirror every sample into a labeled observability Histogram
+(`bind_histogram`), which is how the per-phase totals reach the Prometheus
+/metrics endpoint without a second instrumentation pass.
 """
 
 import contextlib
 import threading
 import time
+
+from elasticdl_tpu.observability.metrics import Reservoir
+
+# Bounded per-phase sample reservoir for percentile estimation.
+RESERVOIR_SIZE = 256
+
+
+class _Phase:
+    __slots__ = ("total", "count", "min", "max", "reservoir")
+
+    def __init__(self):
+        self.total = 0.0
+        self.count = 0
+        self.min = float("inf")
+        self.max = 0.0
+        self.reservoir = Reservoir(RESERVOIR_SIZE)
 
 
 class Timing:
@@ -20,8 +41,15 @@ class Timing:
     def __init__(self, enabled=True):
         self.enabled = enabled
         self._lock = threading.Lock()
-        self._total = {}
-        self._count = {}
+        self._phases = {}
+        self._histogram = None
+
+    def bind_histogram(self, histogram):
+        """Mirror every sample into a metrics.Histogram labeled by phase
+        (e.g. default_registry().histogram("edl_phase_seconds",
+        labelnames=("phase",)))."""
+        self._histogram = histogram
+        return self
 
     @contextlib.contextmanager
     def record(self, phase):
@@ -32,10 +60,7 @@ class Timing:
         try:
             yield
         finally:
-            elapsed = time.perf_counter() - start
-            with self._lock:
-                self._total[phase] = self._total.get(phase, 0.0) + elapsed
-                self._count[phase] = self._count.get(phase, 0) + 1
+            self.add(phase, time.perf_counter() - start)
 
     def add(self, phase, seconds):
         """Fold in an externally-measured duration (e.g. from a jitted
@@ -43,36 +68,53 @@ class Timing:
         if not self.enabled:
             return
         with self._lock:
-            self._total[phase] = self._total.get(phase, 0.0) + seconds
-            self._count[phase] = self._count.get(phase, 0) + 1
+            p = self._phases.get(phase)
+            if p is None:
+                p = self._phases[phase] = _Phase()
+            p.total += seconds
+            p.count += 1
+            p.min = min(p.min, seconds)
+            p.max = max(p.max, seconds)
+            p.reservoir.add(seconds)
+        if self._histogram is not None:
+            self._histogram.labels(phase=phase).observe(seconds)
 
     def summary(self):
-        """{phase: {"total_s", "count", "mean_s"}}"""
+        """{phase: {"total_s", "count", "mean_s", "min_s", "max_s",
+        "p50_s", "p99_s"}}; percentiles are reservoir estimates over up to
+        RESERVOIR_SIZE samples."""
         with self._lock:
-            return {
-                phase: {
-                    "total_s": total,
-                    "count": self._count[phase],
-                    "mean_s": total / max(self._count[phase], 1),
+            out = {}
+            for phase, p in self._phases.items():
+                ordered = sorted(p.reservoir.snapshot())
+                out[phase] = {
+                    "total_s": p.total,
+                    "count": p.count,
+                    "mean_s": p.total / max(p.count, 1),
+                    "min_s": p.min,
+                    "max_s": p.max,
+                    "p50_s": Reservoir.quantile_of(ordered, 0.50),
+                    "p99_s": Reservoir.quantile_of(ordered, 0.99),
                 }
-                for phase, total in self._total.items()
-            }
+            return out
 
     def reset(self):
         with self._lock:
-            self._total.clear()
-            self._count.clear()
+            self._phases.clear()
 
     def report(self, logger, reset=False):
         """DEBUG-log the per-phase breakdown (the reference's
         report_timing shape)."""
         for phase, s in sorted(self.summary().items()):
             logger.debug(
-                "%s: %.6gs total / %d calls / %.6gs mean",
+                "%s: %.6gs total / %d calls / %.6gs mean / "
+                "%.6gs p50 / %.6gs p99",
                 phase,
                 s["total_s"],
                 s["count"],
                 s["mean_s"],
+                s["p50_s"],
+                s["p99_s"],
             )
         if reset:
             self.reset()

@@ -23,10 +23,18 @@ import time
 
 from elasticdl_tpu.common.constants import PodStatus
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.observability import emit_event
+from elasticdl_tpu.observability.metrics import default_registry
 
 logger = get_logger("master.instance_manager")
 
 DEFAULT_MAX_RELAUNCHES = 3
+
+_POD_EVENTS = default_registry().counter(
+    "edl_pod_events_total",
+    "Instance lifecycle transitions seen by the master",
+    labelnames=("kind", "event"),
+)
 
 
 class _Instance:
@@ -94,6 +102,10 @@ class LocalProcessInstanceManager:
             if prev is not None:
                 inst.relaunch_count = prev.relaunch_count
             self._instances[(kind, instance_id)] = inst
+        _POD_EVENTS.labels(kind=kind, event="launch").inc()
+        emit_event(
+            "pod_launch", instance=f"{kind}-{instance_id}", pid=popen.pid
+        )
         logger.info("Launched %s %d (pid %d)", kind, instance_id, popen.pid)
 
     def stop(self):
@@ -131,6 +143,12 @@ class LocalProcessInstanceManager:
             # Teardown in progress: exits are stop()'s own SIGTERMs, not
             # failures — relaunching here would leak processes.
             return
+        _POD_EVENTS.labels(kind=inst.kind, event="exit").inc()
+        emit_event(
+            "pod_exit",
+            instance=f"{inst.kind}-{inst.id}",
+            exit_code=code,
+        )
         if code == 0:
             inst.status = PodStatus.SUCCEEDED
             logger.info("%s %d finished", inst.kind, inst.id)
@@ -159,6 +177,12 @@ class LocalProcessInstanceManager:
                 inst.id,
                 inst.relaunch_count,
             )
+            _POD_EVENTS.labels(kind=inst.kind, event="relaunch").inc()
+            emit_event(
+                "pod_relaunch",
+                instance=f"{inst.kind}-{inst.id}",
+                attempt=inst.relaunch_count,
+            )
             self._launch(inst.kind, inst.id)
             with self._lock:
                 self._instances[(inst.kind, inst.id)].relaunch_count = (
@@ -166,6 +190,12 @@ class LocalProcessInstanceManager:
                 )
         else:
             inst.status = PodStatus.FAILED
+            _POD_EVENTS.labels(kind=inst.kind, event="failed").inc()
+            emit_event(
+                "pod_failed",
+                instance=f"{inst.kind}-{inst.id}",
+                exit_code=code,
+            )
 
     # ---------- status ----------
 
@@ -195,3 +225,10 @@ class LocalProcessInstanceManager:
                 for i in self._instances.values()
                 if i.kind == "worker"
             }
+
+    def total_relaunches(self):
+        """Cumulative relaunches across all instances (job-status RPC)."""
+        with self._lock:
+            return sum(
+                i.relaunch_count for i in self._instances.values()
+            )

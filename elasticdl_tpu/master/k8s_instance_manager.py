@@ -15,8 +15,18 @@ from elasticdl_tpu.common import k8s_client
 from elasticdl_tpu.common.constants import PodStatus
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.master.instance_manager import DEFAULT_MAX_RELAUNCHES
+from elasticdl_tpu.observability import emit_event
+from elasticdl_tpu.observability.metrics import default_registry
 
 logger = get_logger("master.k8s_instance_manager")
+
+# Same family the local-process manager registers; the registry returns
+# the one shared metric for the name.
+_POD_EVENTS = default_registry().counter(
+    "edl_pod_events_total",
+    "Instance lifecycle transitions seen by the master",
+    labelnames=("kind", "event"),
+)
 
 
 class K8sInstanceManager:
@@ -134,6 +144,12 @@ class K8sInstanceManager:
                     )
         with self._lock:
             self._statuses[(kind, instance_id)] = PodStatus.PENDING
+        _POD_EVENTS.labels(kind=kind, event="launch").inc()
+        emit_event(
+            "pod_launch",
+            instance=f"{kind}-{instance_id}",
+            incarnation=incarnation,
+        )
 
     def stop(self):
         with self._lock:
@@ -192,6 +208,10 @@ class K8sInstanceManager:
         if phase == "Succeeded":
             with self._lock:
                 self._statuses[(kind, instance_id)] = PodStatus.SUCCEEDED
+            _POD_EVENTS.labels(kind=kind, event="exit").inc()
+            emit_event(
+                "pod_exit", instance=f"{kind}-{instance_id}", exit_code=0
+            )
             if kind == "worker" and self._membership is not None:
                 self._membership.remove_worker(instance_id)
             return
@@ -214,6 +234,12 @@ class K8sInstanceManager:
         logger.warning(
             "%s %d failed (relaunch=%s)", kind, instance_id, relaunch
         )
+        _POD_EVENTS.labels(kind=kind, event="exit").inc()
+        emit_event(
+            "pod_exit",
+            instance=f"{kind}-{instance_id}",
+            relaunchable=relaunch,
+        )
         if kind == "worker":
             if self._task_d is not None:
                 self._task_d.recover_tasks(instance_id)
@@ -235,6 +261,16 @@ class K8sInstanceManager:
             else:
                 self._statuses[(kind, instance_id)] = PodStatus.FAILED
         if can_relaunch:
+            _POD_EVENTS.labels(kind=kind, event="relaunch").inc()
+            emit_event(
+                "pod_relaunch",
+                instance=f"{kind}-{instance_id}",
+                attempt=count + 1,
+            )
+        else:
+            _POD_EVENTS.labels(kind=kind, event="failed").inc()
+            emit_event("pod_failed", instance=f"{kind}-{instance_id}")
+        if can_relaunch:
             # Reap the failed predecessor (best-effort; it may already be
             # gone when the trigger was a deletion).
             try:
@@ -248,6 +284,11 @@ class K8sInstanceManager:
             self._start(kind, instance_id)
 
     # ---------- status ----------
+
+    def total_relaunches(self):
+        """Cumulative relaunches across all instances (job-status RPC)."""
+        with self._lock:
+            return sum(self._relaunches.values())
 
     def all_workers_failed(self):
         with self._lock:

@@ -14,6 +14,7 @@ import os
 import sys
 import time
 
+from elasticdl_tpu import observability
 from elasticdl_tpu.common import rpc
 from elasticdl_tpu.common.args import build_arguments_from_parsed_result
 from elasticdl_tpu.common.constants import DistributionStrategy
@@ -73,6 +74,22 @@ _PS_RELAY_ARGS = [
 class Master:
     def __init__(self, args):
         self.args = args
+        # The observability plane comes up FIRST so task creation, instance
+        # launches, and every later lifecycle transition land in the event
+        # log/registry. Spawned worker/PS processes find the same obs dir
+        # (and the job identity) through the environment.
+        obs_dir = getattr(args, "metrics_dir", "") or os.environ.get(
+            observability.OBS_DIR_ENV, ""
+        )
+        if obs_dir:
+            os.environ[observability.OBS_DIR_ENV] = obs_dir
+        os.environ[observability.JOB_NAME_ENV] = args.job_name
+        self.obs = observability.setup(
+            role="master", job=args.job_name, obs_dir=obs_dir
+        )
+        # A fixed metrics port is the master's alone; local children must
+        # bind ephemeral ports or they'd all collide on this host.
+        os.environ.pop(observability.METRICS_PORT_ENV, None)
         if args.model_zoo:
             sys.path.insert(0, args.model_zoo)
         self.spec = get_model_spec(args.model_def)
@@ -206,6 +223,11 @@ class Master:
                 K8sInstanceManager,
             )
 
+            envs = {observability.JOB_NAME_ENV: args.job_name}
+            if os.environ.get(observability.OBS_DIR_ENV):
+                envs[observability.OBS_DIR_ENV] = os.environ[
+                    observability.OBS_DIR_ENV
+                ]
             return K8sInstanceManager(
                 args.namespace,
                 args.job_name,
@@ -220,6 +242,7 @@ class Master:
                 worker_priority=args.worker_pod_priority,
                 volumes=args.volume,
                 max_relaunches=args.max_relaunches,
+                envs=envs,
             )
         raise ValueError(f"unknown backend {args.instance_backend!r}")
 
@@ -327,6 +350,14 @@ class Master:
             self.servicer, rpc.MASTER_SERVICE, port=self.args.master_port
         )
         logger.info("Master serving on port %d", self.port)
+        if self.obs.metrics_port:
+            logger.info(
+                "Prometheus metrics on :%d/metrics", self.obs.metrics_port
+            )
+        self.servicer.bind_job_context(
+            instance_manager=self.instance_manager,
+            metrics_port=self.obs.metrics_port,
+        )
         if self.instance_manager is not None:
             if self.args.num_ps:
                 self.instance_manager.start_parameter_servers()
@@ -449,6 +480,9 @@ class Master:
                 why,
                 worker_id,
             )
+            observability.emit_event(
+                "task_timeout", worker=worker_id, reason=why
+            )
             self.task_d.recover_tasks(worker_id)
             self.servicer.forget_worker(worker_id)
             if self.membership is not None:
@@ -476,3 +510,8 @@ class Master:
             self.metrics_service.close()
         if self._server is not None:
             self._server.stop(2)
+        # Flush + release the per-process trace/event files so a monitor
+        # reading them right after exit sees complete lines; also resets
+        # the process-global handle for in-process tests that run several
+        # masters in one interpreter.
+        self.obs.close()

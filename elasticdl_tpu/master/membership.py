@@ -16,8 +16,17 @@ from elasticdl_tpu.common.constants import (
     COORDINATOR_PORT_ROTATION as PORT_ROTATION,
 )
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.observability import emit_event
+from elasticdl_tpu.observability.metrics import default_registry
 
 logger = get_logger("master.membership")
+
+_EPOCH = default_registry().gauge(
+    "edl_membership_epoch", "Current AllReduce membership epoch"
+)
+_WORLD = default_registry().gauge(
+    "edl_membership_world_size", "Workers in the current comm group"
+)
 
 
 class MembershipManager:
@@ -37,6 +46,7 @@ class MembershipManager:
             if list(hosts) != self._hosts:
                 self._hosts = list(hosts)
                 self._group_id += 1
+                self._epoch_changed_locked("replace")
                 logger.info(
                     "Membership epoch %d: %d workers",
                     self._group_id,
@@ -44,11 +54,22 @@ class MembershipManager:
                 )
             return self._group_id
 
+    def _epoch_changed_locked(self, cause):
+        _EPOCH.set(self._group_id)
+        _WORLD.set(len(self._hosts))
+        emit_event(
+            "membership_epoch",
+            epoch=self._group_id,
+            world=len(self._hosts),
+            cause=cause,
+        )
+
     def add_worker_host(self, host):
         with self._lock:
             if host not in self._hosts:
                 self._hosts = self._hosts + [host]
                 self._group_id += 1
+                self._epoch_changed_locked("join")
                 logger.info(
                     "Worker %s joined; membership epoch %d (%d workers)",
                     host,
@@ -82,6 +103,7 @@ class MembershipManager:
             if host in self._hosts:
                 self._hosts = [h for h in self._hosts if h != host]
                 self._group_id += 1
+                self._epoch_changed_locked("leave")
                 logger.info(
                     "Worker %s left; membership epoch %d (%d workers)",
                     host,

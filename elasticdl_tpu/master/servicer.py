@@ -12,6 +12,7 @@ import time
 
 from elasticdl_tpu.common import tensor_utils
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.observability import tracing
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
 logger = get_logger("master.servicer")
@@ -38,6 +39,15 @@ class MasterServicer:
         # (reference servicer.py:93-94).
         self.worker_liveness = {}
         self.max_model_version = 0
+        # Bound after construction (master.prepare) — the instance manager
+        # and metrics endpoint exist only once the master is serving.
+        self._instance_manager = None
+        self._metrics_port = 0
+
+    def bind_job_context(self, instance_manager=None, metrics_port=0):
+        """Late-bind job-status sources created after this servicer."""
+        self._instance_manager = instance_manager
+        self._metrics_port = metrics_port
 
     def _touch(self, worker_id):
         with self._lock:
@@ -68,6 +78,13 @@ class MasterServicer:
             if not self._task_d.finished():
                 res.type = pb.WAIT
             return res
+        # The dispatch is the root of the task's cross-process trace: an
+        # instant event here plus the task_id in every downstream span
+        # (the worker re-keys its context to this id) ties the chain
+        # together in the merged trace.
+        tracing.instant(
+            "dispatch_task", task_id=task_id, worker=request.worker_id
+        )
         return task.to_proto(task_id)
 
     def report_task_result(self, request, context):
@@ -175,7 +192,13 @@ class MasterServicer:
             finished=self._task_d.finished(),
             job_failed=stats["job_failed"],
             records_done=stats["records_done"],
+            tasks_recovered=stats.get("tasks_recovered", 0),
+            metrics_port=self._metrics_port,
         )
+        if self._instance_manager is not None:
+            res.relaunches = self._instance_manager.total_relaunches()
+        if self._membership is not None:
+            res.membership_epoch = self._membership.group_id
         for wid, age in last_seen_ago.items():
             res.worker_last_seen_ago[wid] = age
         for wid, n in stats["doing_by_worker"].items():

@@ -27,9 +27,17 @@ threads, no callbacks.
 import threading
 
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.observability import emit_event
+from elasticdl_tpu.observability.metrics import default_registry
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
 logger = get_logger("master.step_lease")
+
+_LEASES = default_registry().counter(
+    "edl_leases_total",
+    "Step-lease lifecycle transitions",
+    labelnames=("event",),
+)
 
 # Dispatcher owner ids for leases live far below real worker ids so the
 # watchdog/instance-manager recovery paths can tell them apart.
@@ -114,6 +122,15 @@ class StepLeaseManager:
                 res.ranges.append(
                     pb.LeaseRange(shard_name=shard, start=start, end=end)
                 )
+            _LEASES.labels(event="grant").inc()
+            emit_event(
+                "lease_grant",
+                lease_id=lease.id,
+                epoch=lease.epoch,
+                rank=rank,
+                worker=worker_id,
+                n_steps=lease.n_steps,
+            )
             return res
 
     def report_lease(self, lease_id, rank, success, err_message=""):
@@ -130,6 +147,13 @@ class StepLeaseManager:
                     rank,
                 )
                 return
+            _LEASES.labels(event="report").inc()
+            emit_event(
+                "lease_report",
+                lease_id=lease_id,
+                rank=rank,
+                success=success,
+            )
             if not success:
                 logger.warning(
                     "Lease %d failed on rank %d (%s); requeueing its tasks",
@@ -155,6 +179,13 @@ class StepLeaseManager:
                     lease.world,
                     len(lease.task_ids),
                 )
+                _LEASES.labels(event="complete").inc()
+                emit_event(
+                    "lease_complete",
+                    lease_id=lease.id,
+                    world=lease.world,
+                    tasks=len(lease.task_ids),
+                )
                 self._active = None
                 complete = True
         return complete
@@ -176,6 +207,14 @@ class StepLeaseManager:
         self._active = None
         if lease is None:
             return
+        _LEASES.labels(event="abort").inc()
+        emit_event(
+            "lease_abort",
+            lease_id=lease.id,
+            epoch=lease.epoch,
+            penalized=penalize,
+            error=err_message[:200],
+        )
         owner = lease_owner_id(lease.id)
         if penalize:
             self._task_d.fail_owner_tasks(owner, err_message)
@@ -236,6 +275,16 @@ class StepLeaseManager:
         )
         lease.n_steps = max(1, -(-per_rank // batch_size))
         self._active = lease
+        _LEASES.labels(event="mint").inc()
+        emit_event(
+            "lease_mint",
+            lease_id=lease.id,
+            epoch=epoch,
+            world=world,
+            tasks=len(tasks),
+            records=got,
+            n_steps=lease.n_steps,
+        )
         logger.info(
             "Minted lease %d: epoch %d, world %d, %d tasks (%d records), "
             "%d steps x batch %d per rank",

@@ -18,9 +18,39 @@ import time
 
 from elasticdl_tpu.common.constants import MAX_TASK_RETRIES
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.observability import emit_event
+from elasticdl_tpu.observability.metrics import default_registry
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
 logger = get_logger("master.task_dispatcher")
+
+_REG = default_registry()
+_DISPATCHED = _REG.counter(
+    "edl_tasks_dispatched_total",
+    "Tasks handed to workers",
+    labelnames=("type",),
+)
+_REPORTED = _REG.counter(
+    "edl_tasks_reported_total",
+    "Task completions by result",
+    labelnames=("result",),
+)
+_RECOVERED = _REG.counter(
+    "edl_tasks_recovered_total",
+    "In-flight tasks requeued after worker death/timeouts",
+)
+_TODO = _REG.gauge("edl_tasks_todo", "Tasks waiting for dispatch")
+_DOING = _REG.gauge("edl_tasks_doing", "Tasks currently in flight")
+_RECORDS = _REG.gauge(
+    "edl_records_done", "Training records successfully processed"
+)
+
+
+def _type_name(task_type):
+    try:
+        return pb.TaskType.Name(task_type)
+    except ValueError:
+        return str(task_type)
 
 
 class _Task:
@@ -87,6 +117,7 @@ class TaskDispatcher:
         # watchdog (reference master/servicer.py:131-148).
         self._task_durations = {}  # task_type -> deque of seconds (bounded)
         self._records_done = 0  # successful TRAINING records, for monitors
+        self._tasks_recovered = 0  # cumulative, for the job-status RPC
         self._eval_complete_callbacks = []
         self._tasks_done_callbacks = []
 
@@ -121,7 +152,20 @@ class TaskDispatcher:
             self._todo.extendleft(reversed(tasks))
         else:
             self._todo.extend(tasks)
+        self._gauges_locked()
+        if tasks:
+            emit_event(
+                "task_create",
+                type=_type_name(task_type),
+                count=len(tasks),
+                epoch=self._epoch,
+            )
         return len(tasks)
+
+    def _gauges_locked(self):
+        _TODO.set(len(self._todo))
+        _DOING.set(len(self._doing))
+        _RECORDS.set(self._records_done)
 
     def set_completed_records(self, records):
         """Fast-forward past already-trained data on restart-from-checkpoint
@@ -239,6 +283,8 @@ class TaskDispatcher:
             task_id = self._next_task_id
             self._next_task_id += 1
             self._doing[task_id] = (worker_id, task, time.time())
+            _DISPATCHED.labels(type=_type_name(task.type)).inc()
+            self._gauges_locked()
             return task_id, task
 
     def get_eval_task(self, worker_id):
@@ -262,6 +308,8 @@ class TaskDispatcher:
                     task_id = self._next_task_id
                     self._next_task_id += 1
                     self._doing[task_id] = (worker_id, task, time.time())
+                    _DISPATCHED.labels(type=_type_name(task.type)).inc()
+                    self._gauges_locked()
                     return task_id, task
             return -1, None
 
@@ -275,6 +323,7 @@ class TaskDispatcher:
                 return None
             worker_id, task, start_time = entry
             if success:
+                _REPORTED.labels(result="success").inc()
                 self._task_durations.setdefault(
                     task.type, collections.deque(maxlen=100)
                 ).append(time.time() - start_time)
@@ -287,6 +336,7 @@ class TaskDispatcher:
                 evaluation_done = False
                 job_done = self._finished_locked()
             else:
+                _REPORTED.labels(result="failure").inc()
                 task.retry_count += 1
                 if task.retry_count > self._max_task_retries:
                     logger.error(
@@ -296,6 +346,12 @@ class TaskDispatcher:
                         err_message,
                     )
                     self._job_failed = True
+                    emit_event(
+                        "job_failed",
+                        task_id=task_id,
+                        worker=worker_id,
+                        error=err_message[:200],
+                    )
                     # Terminal: drop remaining work so workers drain and
                     # exit; the master process checks job_failed.
                     self._todo.clear()
@@ -303,9 +359,17 @@ class TaskDispatcher:
                     logger.warning(
                         "Re-queueing failed task %s (%s)", task, err_message
                     )
+                    emit_event(
+                        "task_failed",
+                        task_id=task_id,
+                        worker=worker_id,
+                        retry=task.retry_count,
+                        error=err_message[:200],
+                    )
                     self._todo.appendleft(task)
                 evaluation_done = False
                 job_done = False
+            self._gauges_locked()
         # Callbacks run outside the lock: they may call back into us.
         if success and evaluation_done:
             for cb in self._eval_complete_callbacks:
@@ -340,12 +404,21 @@ class TaskDispatcher:
                     self._todo.clear()
                 else:
                     self._todo.appendleft(task)
+            self._gauges_locked()
         for task in failed:
             logger.error(
                 "Task %s failed %d times (last: %s); failing job",
                 task,
                 task.retry_count,
                 err_message,
+            )
+        if ids:
+            emit_event(
+                "task_reassign",
+                worker=owner_id,
+                count=len(ids),
+                penalized=True,
+                error=err_message[:200],
             )
         if ids and not failed:
             logger.warning(
@@ -370,7 +443,16 @@ class TaskDispatcher:
                 if self._stop_training and task.type == pb.TRAINING:
                     continue
                 self._todo.appendleft(task)
+            self._tasks_recovered += len(ids)
+            self._gauges_locked()
         if ids:
+            _RECOVERED.inc(len(ids))
+            emit_event(
+                "task_reassign",
+                worker=worker_id,
+                count=len(ids),
+                task_ids=ids[:32],
+            )
             logger.info(
                 "Recovered %d tasks from worker %d", len(ids), worker_id
             )
@@ -474,5 +556,6 @@ class TaskDispatcher:
                 "epoch": self._epoch,
                 "num_epochs": self._num_epochs,
                 "records_done": self._records_done,
+                "tasks_recovered": self._tasks_recovered,
                 "job_failed": self._job_failed,
             }

@@ -1,0 +1,147 @@
+"""Unified observability plane shared by master, PS, and worker processes.
+
+Three pillars, zero third-party dependencies:
+
+- metrics:   a process-local registry of Counter/Gauge/Histogram exposed in
+             Prometheus text-exposition format over a tiny stdlib HTTP
+             endpoint (exporter.py).
+- tracing:   Chrome-trace/Perfetto-compatible spans written as JSONL per
+             process, with trace context (job, task id, lease epoch)
+             propagated across gRPC hops via the interceptors installed by
+             common/rpc.py.
+- events:    a structured elasticity event log (pod launch/exit/relaunch,
+             lease grant/report/abort, task create/timeout/reassign)
+             appended as events.jsonl alongside the job's metrics.jsonl.
+
+`setup()` configures all three for one process and is called by
+master/main.py, ps/main.py, and worker/main.py. Components never import the
+exporter directly — they use `default_registry()`, `emit_event()`, and
+`tracing.span()`, all of which are cheap no-ops until configured (events,
+traces) or always-on but unexported (metrics). Configuration travels to
+spawned worker/PS processes via the ELASTICDL_OBS_DIR / ELASTICDL_JOB_NAME
+environment variables (set by the master before it launches instances).
+"""
+
+import json
+import os
+
+from elasticdl_tpu.observability import events as _events
+from elasticdl_tpu.observability import tracing as _tracing
+from elasticdl_tpu.observability.metrics import default_registry  # noqa: F401
+
+OBS_DIR_ENV = "ELASTICDL_OBS_DIR"
+JOB_NAME_ENV = "ELASTICDL_JOB_NAME"
+METRICS_PORT_ENV = "ELASTICDL_METRICS_PORT"
+
+emit_event = _events.emit
+
+_handle = None
+
+
+class ObservabilityHandle:
+    """One process's configured observability plane."""
+
+    def __init__(self, role, job, obs_dir, exporter, recorder, event_log):
+        self.role = role
+        self.job = job
+        self.obs_dir = obs_dir
+        self.exporter = exporter
+        self.recorder = recorder
+        self.event_log = event_log
+
+    @property
+    def metrics_port(self):
+        return self.exporter.port if self.exporter is not None else 0
+
+    def close(self):
+        global _handle
+        if self.exporter is not None:
+            self.exporter.close()
+        if self.recorder is not None:
+            self.recorder.close()
+            if _tracing.get_recorder() is self.recorder:
+                _tracing.set_recorder(None)
+        if self.event_log is not None:
+            if _events.get_event_log() is self.event_log:
+                _events.set_event_log(None)
+            self.event_log.close()
+        if _handle is self:
+            _handle = None
+
+
+def current_handle():
+    return _handle
+
+
+def setup(role, job="", obs_dir=None, metrics_port=None, registry=None):
+    """Configure this process's observability plane and return its handle.
+
+    obs_dir=None reads ELASTICDL_OBS_DIR; still-None disables traces and
+    events but keeps the in-process metrics registry live (and exported,
+    when metrics_port says so). metrics_port=None reads
+    ELASTICDL_METRICS_PORT; 0 binds an ephemeral port; a negative value
+    disables the endpoint. The bound endpoint is advertised under
+    <obs_dir>/endpoints/<role>.json so monitors and tests can find every
+    process of a job without guessing ports.
+    """
+    global _handle
+    if _handle is not None:
+        return _handle
+    from elasticdl_tpu.common import log_utils
+    from elasticdl_tpu.observability.exporter import MetricsExporter
+    from elasticdl_tpu.observability.metrics import default_registry
+
+    if obs_dir is None:
+        obs_dir = os.environ.get(OBS_DIR_ENV, "")
+    if not job:
+        job = os.environ.get(JOB_NAME_ENV, "")
+    if metrics_port is None:
+        metrics_port = int(os.environ.get(METRICS_PORT_ENV, "0"))
+    log_utils.set_identity(job=job, role=role)
+
+    recorder = None
+    event_log = None
+    if obs_dir:
+        os.makedirs(obs_dir, exist_ok=True)
+        recorder = _tracing.SpanRecorder(
+            os.path.join(obs_dir, f"trace_{role}.jsonl"),
+            process_name=f"{job}/{role}" if job else role,
+        )
+        _tracing.set_recorder(recorder)
+        event_log = _events.EventLog(
+            os.path.join(obs_dir, "events.jsonl"), job=job, role=role
+        )
+        _events.set_event_log(event_log)
+
+    exporter = None
+    if metrics_port >= 0:
+        try:
+            exporter = MetricsExporter(
+                registry or default_registry(), port=metrics_port
+            )
+        except OSError:
+            # A busy fixed port must not kill a training process; the
+            # metrics stay collectable in-process (and via the next
+            # relaunch, which may land on a free port).
+            log_utils.get_logger("observability").warning(
+                "Could not bind metrics endpoint on port %d", metrics_port
+            )
+    if obs_dir and exporter is not None:
+        _advertise_endpoint(obs_dir, role, job, exporter.port)
+
+    _handle = ObservabilityHandle(
+        role, job, obs_dir, exporter, recorder, event_log
+    )
+    return _handle
+
+
+def _advertise_endpoint(obs_dir, role, job, port):
+    endpoints = os.path.join(obs_dir, "endpoints")
+    os.makedirs(endpoints, exist_ok=True)
+    path = os.path.join(endpoints, f"{role}.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(
+            {"role": role, "job": job, "pid": os.getpid(), "port": port}, f
+        )
+    os.replace(tmp, path)  # atomic: readers never see a partial file

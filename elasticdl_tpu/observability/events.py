@@ -1,0 +1,92 @@
+"""Structured elasticity event log: events.jsonl alongside metrics.jsonl.
+
+One JSON object per line:
+
+    {"ts": 1722700000.1, "seq": 7, "kind": "pod_relaunch", "job": "j",
+     "role": "master", "kind_id": "worker-1", "attempt": 2, ...}
+
+`seq` is a per-process monotonic counter so the job's elasticity timeline
+(launch -> exit -> relaunch, lease grant -> abort, task create -> timeout ->
+reassign) can be reconstructed in exact order even when two events land
+within one clock tick. Emission is a no-op until observability.setup()
+installs a log, so library code can emit unconditionally.
+
+Event kinds (docs/OBSERVABILITY.md#event-schema):
+  pod_launch / pod_exit / pod_relaunch / pod_failed
+  lease_mint / lease_grant / lease_report / lease_abort / lease_complete
+  task_create / task_timeout / task_reassign / task_failed / job_failed
+  worker_removed / membership_epoch
+"""
+
+import json
+import os
+import threading
+import time
+
+
+class EventLog:
+    def __init__(self, path, job="", role=""):
+        self.path = path
+        self._job = job
+        self._role = role
+        self._lock = threading.Lock()
+        self._seq = 0
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._file = open(path, "a", buffering=1)
+
+    def emit(self, kind, **fields):
+        record = {"ts": time.time(), "kind": kind}
+        if self._job:
+            record["job"] = self._job
+        if self._role:
+            record["role"] = self._role
+        record.update(fields)
+        with self._lock:
+            if self._file.closed:
+                return
+            self._seq += 1
+            record["seq"] = self._seq
+            self._file.write(
+                json.dumps(record, separators=(",", ":")) + "\n"
+            )
+
+    def close(self):
+        with self._lock:
+            if not self._file.closed:
+                self._file.close()
+
+
+_event_log = None
+
+
+def set_event_log(log):
+    global _event_log
+    _event_log = log
+
+
+def get_event_log():
+    return _event_log
+
+
+def emit(kind, **fields):
+    """Append one event; silently dropped until a log is configured."""
+    log = _event_log
+    if log is not None:
+        log.emit(kind, **fields)
+
+
+def read_events(path):
+    """Parse an events.jsonl (merge helper for tools/tests). A torn final
+    line — the writer was SIGKILLed mid-record, the very scenario this log
+    diagnoses — yields the valid prefix instead of raising."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return events

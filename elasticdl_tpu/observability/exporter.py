@@ -1,0 +1,63 @@
+"""Stdlib-only Prometheus scrape endpoint.
+
+One daemonized ThreadingHTTPServer per process serving:
+
+    /metrics   the registry in text-exposition format
+    /healthz   "ok" — a liveness probe target for k8s pod specs
+
+No third-party dependency: the exposition format is plain text and the
+stdlib HTTP server is enough for a scraper that polls every few seconds.
+Binds 0.0.0.0 (a scrape endpoint is only useful off-host) on the requested
+port; port 0 picks an ephemeral port, published via `.port` and the
+endpoints/ advertisement written by observability.setup().
+"""
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    registry = None
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = self.registry.expose().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif path == "/healthz":
+            body = b"ok\n"
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self.send_error(404)
+
+    def log_message(self, format, *args):
+        # Scrapes every few seconds must not spam the training log.
+        pass
+
+
+class MetricsExporter:
+    def __init__(self, registry, port=0, host="0.0.0.0"):
+        handler = type("_BoundHandler", (_Handler,), {"registry": registry})
+        self._server = ThreadingHTTPServer((host, port), handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="edl-metrics-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()

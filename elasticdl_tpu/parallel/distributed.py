@@ -111,7 +111,7 @@ def ensure_world(coordinator_addr, world_size, rank, epoch=None):
     # its world) and on a rejoin. Drop any cached backends; callers must
     # host-snapshot device state BEFORE calling (the trainer does).
     _clear_backends()
-    jax.distributed.initialize(
+    init_kwargs = dict(
         coordinator_address=coordinator_addr,
         num_processes=world_size,
         process_id=rank,
@@ -119,6 +119,17 @@ def ensure_world(coordinator_addr, world_size, rank, epoch=None):
         shutdown_timeout_seconds=SHUTDOWN_TIMEOUT_SECONDS,
         heartbeat_timeout_seconds=HEARTBEAT_TIMEOUT_SECONDS,
     )
+    # Older jax (< 0.5) has neither timeout knob; drop what the installed
+    # signature doesn't accept rather than crash every multi-host worker.
+    import inspect
+
+    accepted = inspect.signature(
+        jax.distributed.initialize
+    ).parameters
+    init_kwargs = {
+        k: v for k, v in init_kwargs.items() if k in accepted
+    }
+    jax.distributed.initialize(**init_kwargs)
     _current.update(
         coordinator=coordinator_addr,
         world=world_size,

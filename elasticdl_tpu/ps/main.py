@@ -6,6 +6,7 @@ import sys
 
 import grpc
 
+from elasticdl_tpu import observability
 from elasticdl_tpu.common.args import ps_parser, validate_args
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.model_utils import get_model_spec
@@ -18,6 +19,9 @@ logger = get_logger("ps.main")
 def main(argv=None):
     args = ps_parser().parse_args(argv)
     validate_args(args)
+    obs = observability.setup(
+        role=f"ps-{args.ps_id}", job=args.job_name
+    )
     if args.model_zoo:
         sys.path.insert(0, args.model_zoo)
     # The optimizer spec comes from the model zoo module, like the reference
@@ -57,6 +61,7 @@ def main(argv=None):
 
     ps.wait(master_liveness_check=master_alive, poll_seconds=10)
     ps.stop()
+    obs.close()
     return 0
 
 

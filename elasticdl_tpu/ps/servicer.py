@@ -23,6 +23,8 @@ import numpy as np
 
 from elasticdl_tpu.common import tensor_utils
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.observability import tracing
+from elasticdl_tpu.observability.metrics import default_registry
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 from elasticdl_tpu.ps.optimizer import PSOptimizer
 from elasticdl_tpu.ps.parameters import Parameters
@@ -30,6 +32,29 @@ from elasticdl_tpu.ps.parameters import Parameters
 logger = get_logger("ps.servicer")
 
 DEFAULT_REPORT_VERSION_STEPS = 100
+
+# Process-global so N in-process shards aggregate (one registry per OS
+# process; real deployments run one shard per process).
+_REG = default_registry()
+_PUSH_BYTES = _REG.counter(
+    "edl_ps_push_bytes_total", "Gradient push request bytes received"
+)
+_PULL_BYTES = _REG.counter(
+    "edl_ps_pull_bytes_total",
+    "Parameter/embedding pull response bytes sent",
+    labelnames=("rpc",),
+)
+_PUSHES = _REG.counter(
+    "edl_ps_push_total",
+    "Gradient pushes by outcome",
+    labelnames=("outcome",),
+)
+_PS_VERSION = _REG.gauge(
+    "edl_ps_model_version", "Latest model version applied by this PS"
+)
+_APPLY_SECONDS = _REG.histogram(
+    "edl_ps_apply_seconds", "Optimizer apply latency per push"
+)
 
 
 class PserverServicer:
@@ -114,6 +139,7 @@ class PserverServicer:
                         self._params.dense[name], name
                     )
                 )
+        _PULL_BYTES.labels(rpc="pull_dense_parameters").inc(res.ByteSize())
         return res
 
     def pull_embedding_vectors(self, request, context):
@@ -129,7 +155,9 @@ class PserverServicer:
         values = table.lookup(ids)
         if request.value_dtype == pb.DT_BFLOAT16:
             values = values.astype(tensor_utils.bfloat16)
-        return tensor_utils.ndarray_to_tensor_pb(values, request.name)
+        res = tensor_utils.ndarray_to_tensor_pb(values, request.name)
+        _PULL_BYTES.labels(rpc="pull_embedding_vectors").inc(res.ByteSize())
+        return res
 
     def pull_embedding_table(self, request, context):
         """One page of a table's materialized rows — the export
@@ -142,14 +170,22 @@ class PserverServicer:
             start=request.start_row,
             count=request.max_rows or None,
         )
-        return tensor_utils.ndarray_to_indexed_slices_pb(
+        res = tensor_utils.ndarray_to_indexed_slices_pb(
             values, ids, request.name
         )
+        _PULL_BYTES.labels(rpc="pull_embedding_table").inc(res.ByteSize())
+        return res
 
     def push_gradients(self, request, context):
+        _PUSH_BYTES.inc(request.ByteSize())
         if self._use_async:
-            return self._push_async(request)
-        return self._push_sync(request)
+            res = self._push_async(request)
+        else:
+            res = self._push_sync(request)
+        _PUSHES.labels(
+            outcome="accepted" if res.accepted else "rejected"
+        ).inc()
+        return res
 
     # ---------- async path ----------
 
@@ -164,11 +200,15 @@ class PserverServicer:
         # (the reference Go server likewise applies under its mutex,
         # go/pkg/ps/server.go:67-68,176-206).
         with self._version_lock:
-            self._apply_model_pb(request.gradients)
+            start = time.perf_counter()
+            with tracing.span("ps_apply_async"):
+                self._apply_model_pb(request.gradients)
+            _APPLY_SECONDS.observe(time.perf_counter() - start)
             self._params.total_records += request.batch_size
             self._params.version += 1
             version = self._params.version
             snapshot = self._snapshot_if_due(version)
+        _PS_VERSION.set(version)
         self._post_apply(version, snapshot)
         return pb.PushGradientsResponse(accepted=True, version=version)
 
@@ -227,6 +267,7 @@ class PserverServicer:
                     quorum, self._grads_to_wait, self._grad_n,
                 )
             # Quorum reached: average dense, merge sparse, apply once.
+            apply_start = time.perf_counter()
             self._opt.begin_apply()
             try:
                 for name, g in self._grad_sum.items():
@@ -243,6 +284,7 @@ class PserverServicer:
                     )
             finally:
                 self._opt.end_apply()
+            _APPLY_SECONDS.observe(time.perf_counter() - apply_start)
             self._grad_sum.clear()
             self._sparse_acc.clear()
             self._grad_n = 0
@@ -251,6 +293,7 @@ class PserverServicer:
             self._params.version += 1
             version = self._params.version
             snapshot = self._snapshot_if_due(version)
+        _PS_VERSION.set(version)
         self._post_apply(version, snapshot)
         return pb.PushGradientsResponse(accepted=True, version=version)
 

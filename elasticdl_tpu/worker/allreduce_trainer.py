@@ -51,6 +51,7 @@ import grpc
 import jax
 import numpy as np
 
+from elasticdl_tpu.common.jax_compat import shard_map
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.parallel import broadcast, distributed
 from elasticdl_tpu.parallel.mesh import (
@@ -1020,7 +1021,7 @@ class AllReduceTrainer(JaxTrainer):
         def step_fn(variables, opt_state, rng, features, labels):
             params = variables["params"]
             state = {k: v for k, v in variables.items() if k != "params"}
-            loss, grads, new_state = jax.shard_map(
+            loss, grads, new_state = shard_map(
                 shard_fn,
                 mesh=mesh,
                 in_specs=(P(), P(), P(), P(axes), P(axes)),

@@ -3,6 +3,7 @@
 
 import sys
 
+from elasticdl_tpu import observability
 from elasticdl_tpu.common.args import validate_args, worker_parser
 from elasticdl_tpu.common.constants import DistributionStrategy, JobType
 from elasticdl_tpu.common.log_utils import get_logger
@@ -83,6 +84,9 @@ def build_trainer(args, spec, master_client):
 def main(argv=None):
     args = worker_parser().parse_args(argv)
     validate_args(args)
+    obs = observability.setup(
+        role=f"worker-{args.worker_id}", job=args.job_name
+    )
     if args.model_zoo:
         sys.path.insert(0, args.model_zoo)
     spec = get_model_spec(args.model_def)
@@ -160,6 +164,7 @@ def main(argv=None):
         close = getattr(trainer, "close", None)
         if close is not None:
             close()
+        obs.close()
     logger.info("Worker %d exiting", args.worker_id)
     return 0
 

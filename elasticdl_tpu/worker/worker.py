@@ -15,10 +15,27 @@ from elasticdl_tpu.common.constants import (
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.model_utils import Modes
 from elasticdl_tpu.common.timing import Timing
+from elasticdl_tpu.observability import tracing
+from elasticdl_tpu.observability.metrics import default_registry
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 from elasticdl_tpu.worker.task_data_service import TaskDataService
 
 logger = get_logger("worker.worker")
+
+_REG = default_registry()
+_STEPS = _REG.counter(
+    "edl_worker_steps_total", "Minibatch steps this worker completed"
+)
+_TASKS = _REG.counter(
+    "edl_worker_tasks_total",
+    "Tasks this worker processed, by result",
+    labelnames=("result",),
+)
+_PHASE_SECONDS = _REG.histogram(
+    "edl_phase_seconds",
+    "Worker phase latency (task_process/batch_process + trainer phases)",
+    labelnames=("phase",),
+)
 
 
 class Worker:
@@ -53,7 +70,12 @@ class Worker:
         # driven by whole-world leases instead of independent task pulls.
         self._lease_mode = lease_mode
         self._steps = 0
-        self._timing = Timing()
+        self._timing = Timing().bind_histogram(_PHASE_SECONDS)
+        trainer_timing = getattr(trainer, "timing", None)
+        if trainer_timing is not None:
+            # Trainer phases (pull/step/push) reach /metrics through the
+            # same labeled histogram.
+            trainer_timing.bind_histogram(_PHASE_SECONDS)
         # One-shot device trace of steady-state steps (past the compile):
         # [profile_start_step, profile_start_step + profile_steps), written
         # as a TensorBoard trace-viewer profile. The reference's deepest
@@ -192,6 +214,7 @@ class Worker:
                     self._trainer.world_size,
                 )
                 continue
+            tracing.set_context(lease_epoch=lease.epoch)
             try:
                 loss = None
                 for i in range(lease.n_steps):
@@ -208,6 +231,7 @@ class Worker:
                         features, labels
                     )
                     self._steps += 1
+                    _STEPS.inc()
                     if self._steps % self._log_loss_steps == 0:
                         logger.info(
                             "Step %d (lease %d) loss %.6f",
@@ -289,14 +313,25 @@ class Worker:
     # ---------- task/batch processing ----------
 
     def _run_task(self, task, process_batch):
+        # Re-key this thread's trace context to the task: every span and
+        # RPC from here to report_task_result (PS pulls/pushes included)
+        # carries the task id and one fresh trace id, which is what lets
+        # trace_report.py stitch the task's cross-process chain together.
+        tracing.set_context(task_id=task.task_id)
         try:
-            with self._timing.record("task_process"):
+            with self._timing.record("task_process"), tracing.span(
+                "task_process",
+                task_type=pb.TaskType.Name(task.type),
+            ):
                 for records in self._tds.read_batches(
                     task, self._minibatch_size
                 ):
-                    with self._timing.record("batch_process"):
+                    with self._timing.record("batch_process"), tracing.span(
+                        "batch_process"
+                    ):
                         self._process_with_retries(process_batch, records)
             self._tds.report_task(task.task_id)
+            _TASKS.labels(result="success").inc()
         except Exception as e:
             logger.error(
                 "Task %d failed: %s\n%s",
@@ -305,6 +340,7 @@ class Worker:
                 traceback.format_exc(),
             )
             self._tds.report_task(task.task_id, err_message=str(e))
+            _TASKS.labels(result="failure").inc()
         finally:
             # Per-task phase breakdown at DEBUG (reference worker.py:380-382
             # reports get_model/report_gradient/batch_process the same way);
@@ -345,6 +381,7 @@ class Worker:
         )
         if accepted:
             self._steps += 1
+            _STEPS.inc()
             if self._steps % self._log_loss_steps == 0:
                 # Only materialize the (lazy, on-device) loss when logging;
                 # every other step stays dispatch-ahead.

@@ -43,6 +43,7 @@ def test_kill_worker_mid_job_drill(tmp_path, strategy, num_ps):
         for r in test_module.make_linear_records(256):
             w.write(r)
     output = str(tmp_path / "model.npz")
+    obs_dir = str(tmp_path / "obs")
     result = run_drill(
         data,
         model_zoo=os.path.join(REPO, "tests"),
@@ -54,7 +55,10 @@ def test_kill_worker_mid_job_drill(tmp_path, strategy, num_ps):
         # startup, so the rejoin is observable.
         num_epochs=400,
         extra_args=("--output", output),
-        env_overrides={"JAX_PLATFORMS": "cpu"},
+        env_overrides={
+            "JAX_PLATFORMS": "cpu",
+            "ELASTICDL_OBS_DIR": obs_dir,
+        },
         timeout=420,
     )
     assert result["completed"], result.get("log_tail", "")[-1500:]
@@ -81,6 +85,31 @@ def test_kill_worker_mid_job_drill(tmp_path, strategy, num_ps):
     with np.load(output) as d:
         kernel = d["params/Dense_0/kernel"].reshape(-1)
     np.testing.assert_allclose(kernel, test_module.TRUE_W, atol=0.1)
+    # The observability event log reconstructs the drill's elasticity
+    # timeline: the victim's launch precedes its kill-exit, which precedes
+    # its relaunch — and a replacement launch follows.
+    from elasticdl_tpu.observability.events import read_events
+
+    records = read_events(os.path.join(obs_dir, "events.jsonl"))
+    victims = [
+        r
+        for r in records
+        if r.get("instance", "").startswith("worker-")
+        and r["kind"].startswith("pod_")
+    ]
+    by_instance = {}
+    for r in victims:
+        by_instance.setdefault(r["instance"], []).append(r["kind"])
+    relaunched_instance = next(
+        (k for k, kinds in by_instance.items() if "pod_relaunch" in kinds),
+        None,
+    )
+    assert relaunched_instance, by_instance
+    kinds = by_instance[relaunched_instance]
+    assert kinds.index("pod_launch") < kinds.index("pod_exit"), kinds
+    assert kinds.index("pod_exit") < kinds.index("pod_relaunch"), kinds
+    assert "pod_launch" in kinds[kinds.index("pod_relaunch"):], kinds
+    assert any(r["kind"] == "task_create" for r in records)
 
 
 @pytest.mark.parametrize(

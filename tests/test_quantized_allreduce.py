@@ -8,7 +8,7 @@ import pytest
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax import shard_map
+from elasticdl_tpu.common.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from elasticdl_tpu.parallel.quantized import (
@@ -77,11 +77,16 @@ def test_quantized_pmean_tree_roundtrip():
             )
 
 
+@pytest.mark.slow
 def test_dp_training_with_quantized_gradients_converges():
     """Explicit-gradient DP step: per-shard grads, quantized-allreduce
     mean, shared SGD update — converges to the same linear solution as
     exact reduction (quantization noise behaves like stochastic
-    rounding, not bias)."""
+    rounding, not bias).
+
+    slow: this compile wedges XLA for minutes (occasionally SIGABRTs the
+    interpreter) on a 1-core CPU host — run it on real hardware, not in
+    the wall-clock-capped tier-1 lane."""
     mesh = _mesh()
     rng = np.random.default_rng(2)
     true_w = np.asarray([1.0, -2.0, 3.0, 0.5], np.float32)
@@ -356,12 +361,17 @@ def test_quantized_grads_on_multihost_zero1_mesh():
         assert b == pytest.approx(a, rel=0.15), (exact, quant)
 
 
+@pytest.mark.slow
 def test_trainer_quantized_grads_compose_with_tp():
     """--quantized_grads --model_parallel_size 2 (VERDICT r4 #5): the
     data-axis mean of model-sharded grads quantizes while the model-axis
     collectives stay exact — losses track the exact DP x TP trainer
     within int8 noise, still converging, with the model axis really
-    formed (no silent fallback or warn-and-ignore)."""
+    formed (no silent fallback or warn-and-ignore).
+
+    slow: like the DP convergence test above, this DP x TP quantized
+    compile wedges/aborts XLA on a 1-core CPU host — keep it out of the
+    wall-clock-capped tier-1 lane."""
     import tests.test_module as test_module
     from elasticdl_tpu.worker.allreduce_trainer import AllReduceTrainer
     from elasticdl_tpu.worker.master_client import MasterClient

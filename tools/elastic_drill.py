@@ -157,6 +157,23 @@ def run_drill(
         "records_done": None,
     }
     try:
+        # Only open the gRPC channel once the port actually accepts: a
+        # channel whose first connect attempt predates the subprocess
+        # server's bind can wedge in UNAVAILABLE on sandboxed/virtualized
+        # network stacks (observed with grpc 1.68 under the CI sandbox),
+        # and the whole drill then reads as "job never started".
+        bind_deadline = time.time() + timeout
+        while time.time() < bind_deadline:
+            if train.poll() is not None:
+                break
+            try:
+                probe = socket.create_connection(
+                    ("127.0.0.1", port), timeout=1
+                )
+                probe.close()
+                break
+            except OSError:
+                time.sleep(0.2)
         stub = rpc.Stub(
             rpc.build_channel(f"127.0.0.1:{port}"), rpc.MASTER_SERVICE
         )
