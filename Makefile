@@ -18,7 +18,17 @@ verify:
 bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --smoke
 
+# Static invariants (no jax needed): every RPC method has a deadline
+# policy and no call site bypasses the retry/deadline interceptor plane.
+lint:
+	python tools/check_rpc_deadlines.py
+
+# The chaos scenario suite (real multi-process jobs with injected faults;
+# docs/ROBUSTNESS.md catalog) under a hard wall-clock cap.
+chaos:
+	set -o pipefail; timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly
+
 native:
 	@if [ -f elasticdl_tpu/native/Makefile ]; then $(MAKE) -C elasticdl_tpu/native; else echo "native kernels not present yet"; fi
 
-.PHONY: proto test verify bench-smoke native
+.PHONY: proto test verify bench-smoke lint chaos native

@@ -1,0 +1,25 @@
+"""Deterministic fault injection for the RPC plane and for whole processes.
+
+Two layers (docs/ROBUSTNESS.md keeps the scenario catalog):
+
+- injection: a seeded FaultSchedule drives a gRPC server/client interceptor
+  pair that injects UNAVAILABLE aborts, latency, deadline overruns, and
+  payload truncation by method-name pattern. Schedules are counter-based
+  (the Nth matching call misbehaves), so a test or drill replays the exact
+  same fault sequence every run.
+- process: SIGKILL/SIGSTOP/SIGCONT helpers addressed by role (worker/PS/
+  master command-line patterns), used by tools/elastic_drill.py scenarios.
+
+Real processes pick schedules up from the ELASTICDL_CHAOS environment
+variable (JSON, see injection.schedule_from_env); in-process tests pass a
+FaultSchedule directly to rpc.serve / rpc.build_channel.
+"""
+
+from elasticdl_tpu.chaos.injection import (  # noqa: F401
+    ChaosClientInterceptor,
+    ChaosServerInterceptor,
+    FaultRule,
+    FaultSchedule,
+    CHAOS_ENV,
+    schedule_from_env,
+)

@@ -1,0 +1,245 @@
+"""Seeded, counter-based gRPC fault injection.
+
+A FaultSchedule owns a list of FaultRules plus one seeded RNG. Each rule
+matches RPCs by method-name substring and side ("server" or "client") and
+fires on a deterministic call-index window: the rule's counter increments on
+every matching call, and calls with start <= index < start + count get the
+fault. Latency jitter draws from the schedule's seeded RNG, so two runs
+with the same schedule and the same call order inject byte-identical fault
+sequences — which is what lets the unit suite assert retry/backoff/breaker
+behavior without real processes or wall-clock races.
+
+Fault kinds:
+  unavailable  server: context.abort(UNAVAILABLE); client: synthetic
+               UNAVAILABLE raised before the wire — both retryable.
+  latency      sleep latency_s (+/- seeded jitter) before serving.
+  deadline     server: sleep past the caller's remaining deadline; client:
+               shrink the call's timeout to ~1ms. Deterministic
+               DEADLINE_EXCEEDED either way.
+  truncate     server only: the response payload is cut in half at the
+               serializer, simulating a torn payload; the client sees a
+               deserialization failure (INTERNAL — fail-fast, the worker's
+               minibatch retry ladder owns recovery).
+"""
+
+import dataclasses
+import json
+import os
+import random
+import threading
+import time
+
+import grpc
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.observability.metrics import default_registry
+
+logger = get_logger("chaos.injection")
+
+CHAOS_ENV = "ELASTICDL_CHAOS"
+
+KINDS = ("unavailable", "latency", "deadline", "truncate")
+
+_INJECTED = default_registry().counter(
+    "edl_chaos_injected_total",
+    "Faults injected by the chaos interceptors",
+    labelnames=("kind", "side"),
+)
+
+
+@dataclasses.dataclass
+class FaultRule:
+    method: str  # substring of the full method name ("" matches all)
+    kind: str  # one of KINDS
+    start: int = 0  # first matching call index (0-based) affected
+    count: int = -1  # number of calls affected; -1 = unbounded
+    latency_s: float = 0.25
+    side: str = "server"  # "server" | "client"
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        if self.side not in ("server", "client"):
+            raise ValueError(f"unknown fault side {self.side!r}")
+
+
+class FaultSchedule:
+    """Thread-safe, deterministic fault decisions for a rule list."""
+
+    def __init__(self, rules, seed=0):
+        self.rules = [
+            r if isinstance(r, FaultRule) else FaultRule(**r)
+            for r in rules
+        ]
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._counts = [0] * len(self.rules)
+        self._lock = threading.Lock()
+
+    def decide(self, method, side):
+        """Faults to apply to this call (consumes one count per matching
+        rule). Deterministic given the per-method call order."""
+        active = []
+        with self._lock:
+            for i, rule in enumerate(self.rules):
+                if rule.side != side or rule.method not in method:
+                    continue
+                index = self._counts[i]
+                self._counts[i] += 1
+                if index >= rule.start and (
+                    rule.count < 0 or index < rule.start + rule.count
+                ):
+                    active.append(rule)
+        return active
+
+    def jitter(self, rule):
+        """Jittered latency for a latency-kind fault; the draw comes from
+        the schedule's seeded RNG so sequences replay."""
+        with self._lock:
+            return rule.latency_s * (0.5 + self._rng.random())
+
+    # -- (de)serialization: drills ship schedules to subprocesses via env --
+
+    def to_json(self):
+        return json.dumps(
+            {
+                "seed": self.seed,
+                "rules": [dataclasses.asdict(r) for r in self.rules],
+            }
+        )
+
+    @classmethod
+    def from_json(cls, raw):
+        spec = json.loads(raw)
+        return cls(spec.get("rules", ()), seed=spec.get("seed", 0))
+
+
+_env_schedule = None
+_env_lock = threading.Lock()
+
+
+def schedule_from_env():
+    """The process-wide schedule from ELASTICDL_CHAOS, or None. Cached: all
+    servers/channels of one process share one schedule (and therefore one
+    set of rule counters), mirroring how one process experiences one
+    network."""
+    global _env_schedule
+    raw = os.environ.get(CHAOS_ENV, "")
+    if not raw:
+        return None
+    with _env_lock:
+        if _env_schedule is None:
+            try:
+                _env_schedule = FaultSchedule.from_json(raw)
+                logger.warning(
+                    "CHAOS ACTIVE: %d fault rules (seed %d) from $%s",
+                    len(_env_schedule.rules),
+                    _env_schedule.seed,
+                    CHAOS_ENV,
+                )
+            except (ValueError, TypeError) as e:
+                logger.error("Bad %s (%s); chaos disabled", CHAOS_ENV, e)
+                os.environ.pop(CHAOS_ENV, None)
+                return None
+        return _env_schedule
+
+
+def reset_env_schedule():
+    """Drop the cached env schedule (tests that flip ELASTICDL_CHAOS)."""
+    global _env_schedule
+    with _env_lock:
+        _env_schedule = None
+
+
+class ChaosServerInterceptor(grpc.ServerInterceptor):
+    """Injects scheduled faults into a server's handlers."""
+
+    def __init__(self, schedule: FaultSchedule):
+        self._schedule = schedule
+        # Serialization runs on the same server thread as the handler, so a
+        # threadlocal carries the truncate decision from handler to
+        # serializer.
+        self._local = threading.local()
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if handler is None or handler.unary_unary is None:
+            return handler
+        inner = handler.unary_unary
+        serializer = handler.response_serializer
+        method = handler_call_details.method
+        schedule = self._schedule
+        local = self._local
+
+        def chaotic(request, context):
+            local.truncate = False
+            for rule in schedule.decide(method, "server"):
+                _INJECTED.labels(kind=rule.kind, side="server").inc()
+                if rule.kind == "latency":
+                    time.sleep(schedule.jitter(rule))
+                elif rule.kind == "deadline":
+                    remaining = context.time_remaining()
+                    if remaining is not None:
+                        # Sleep just past the caller's deadline — the
+                        # sleep is self-bounding (the client's own
+                        # deadline caps it), so no separate cap that
+                        # could undershoot large deadlines and turn the
+                        # fault into a silent latency blip.
+                        time.sleep(remaining + 0.5)
+                    else:
+                        # No client deadline to overrun: degenerate to a
+                        # plain latency fault rather than parking a
+                        # server thread forever.
+                        time.sleep(rule.latency_s)
+                elif rule.kind == "truncate":
+                    local.truncate = True
+                elif rule.kind == "unavailable":
+                    context.abort(
+                        grpc.StatusCode.UNAVAILABLE,
+                        f"chaos: injected UNAVAILABLE on {method}",
+                    )
+            return inner(request, context)
+
+        def chaotic_serializer(message):
+            data = serializer(message)
+            if getattr(local, "truncate", False):
+                local.truncate = False
+                return data[: len(data) // 2]
+            return data
+
+        return grpc.unary_unary_rpc_method_handler(
+            chaotic,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=chaotic_serializer,
+        )
+
+
+class ChaosClientInterceptor(grpc.UnaryUnaryClientInterceptor):
+    """Injects scheduled faults on the client side, before the wire."""
+
+    def __init__(self, schedule: FaultSchedule):
+        self._schedule = schedule
+
+    def intercept_unary_unary(self, continuation, details, request):
+        timeout = details.timeout
+        for rule in self._schedule.decide(details.method, "client"):
+            _INJECTED.labels(kind=rule.kind, side="client").inc()
+            if rule.kind == "latency":
+                time.sleep(self._schedule.jitter(rule))
+            elif rule.kind == "deadline":
+                # Shrink the deadline so the real call overruns it.
+                timeout = 0.001
+            elif rule.kind == "unavailable":
+                from elasticdl_tpu.common.rpc import SyntheticRpcError
+
+                raise SyntheticRpcError(
+                    grpc.StatusCode.UNAVAILABLE,
+                    f"chaos: injected UNAVAILABLE on {details.method}",
+                )
+            # "truncate" is server-side only: the client cannot corrupt the
+            # response before its own deserializer sees it.
+        if timeout != details.timeout:
+            from elasticdl_tpu.common.rpc import _CallDetails
+
+            details = _CallDetails(details, timeout)
+        return continuation(details, request)

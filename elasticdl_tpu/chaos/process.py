@@ -1,0 +1,91 @@
+"""Process-level fault primitives for chaos drills.
+
+Roles are addressed by command-line pattern, the same way
+tools/elastic_drill.py finds its victim: every instance of a local job
+carries the master address on its argv, so (module, master_port, extra
+needles) uniquely identifies one process without tracking pids across
+relaunches."""
+
+import os
+import signal
+import subprocess
+import time
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("chaos.process")
+
+ROLE_MODULES = {
+    "worker": "elasticdl_tpu.worker.main",
+    "ps": "elasticdl_tpu.ps.main",
+}
+
+
+def find_role_pid(role, instance_id, master_port, timeout=60):
+    """Pid of the live worker/PS subprocess with this id in the job rooted
+    at master_port. Raises RuntimeError when none shows up in time."""
+    module = ROLE_MODULES[role]
+    id_flag = "--worker_id" if role == "worker" else "--ps_id"
+    needles = (
+        f"--master_addr 127.0.0.1:{master_port}",
+        f"{id_flag} {instance_id}",
+    )
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        out = subprocess.run(
+            ["pgrep", "-af", module], capture_output=True, text=True
+        ).stdout
+        for line in out.splitlines():
+            if all(n in line for n in needles):
+                return int(line.split()[0])
+        time.sleep(0.2)
+    raise RuntimeError(
+        f"{role} {instance_id} process not found for master port "
+        f"{master_port}"
+    )
+
+
+def find_job_pids(master_port):
+    """All live worker/PS pids of the job rooted at master_port (the
+    leftover-process check drills run at teardown)."""
+    pids = []
+    needle = f"--master_addr 127.0.0.1:{master_port}"
+    for module in ROLE_MODULES.values():
+        out = subprocess.run(
+            ["pgrep", "-af", module], capture_output=True, text=True
+        ).stdout
+        for line in out.splitlines():
+            if needle in line:
+                pids.append((int(line.split()[0]), line.strip()))
+    return pids
+
+
+def deliver(pid, sig):
+    """Send a signal, tolerating an already-gone target. Returns True when
+    the signal was delivered."""
+    try:
+        os.kill(pid, sig)
+        return True
+    except ProcessLookupError:
+        return False
+
+
+def kill_role(role, instance_id, master_port, timeout=60):
+    """SIGKILL one role instance; returns its pid."""
+    pid = find_role_pid(role, instance_id, master_port, timeout)
+    logger.info("chaos: SIGKILL %s %d (pid %d)", role, instance_id, pid)
+    deliver(pid, signal.SIGKILL)
+    return pid
+
+
+def stall(pid, seconds):
+    """SIGSTOP a process for `seconds`, then SIGCONT it. Returns True when
+    both signals were delivered (the target survived the stall)."""
+    if not deliver(pid, signal.SIGSTOP):
+        return False
+    logger.info("chaos: SIGSTOP pid %d for %.1fs", pid, seconds)
+    try:
+        time.sleep(seconds)
+    finally:
+        resumed = deliver(pid, signal.SIGCONT)
+    return resumed

@@ -341,6 +341,8 @@ def _top(args):
             elastic += f" relaunches={status.relaunches}"
         if status.tasks_recovered:
             elastic += f" recovered={status.tasks_recovered}"
+        if status.tasks_abandoned:
+            elastic += f" abandoned={status.tasks_abandoned}"
         if status.membership_epoch:
             elastic += f" mepoch={status.membership_epoch}"
         print(

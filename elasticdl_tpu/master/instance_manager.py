@@ -16,6 +16,7 @@ split:
   absent.
 """
 
+import os
 import subprocess
 import sys
 import threading
@@ -93,8 +94,14 @@ class LocalProcessInstanceManager:
 
     def _launch(self, kind, instance_id):
         argv = self._command_for(kind, instance_id)
+        # Children get the master's environment (log level/format,
+        # observability dir/job, chaos schedule all ride along) plus a
+        # per-instance ELASTICDL_ROLE stamp, so every process of one
+        # chaos run logs with a correlatable identity.
+        env = dict(os.environ)
+        env["ELASTICDL_ROLE"] = f"{kind}-{instance_id}"
         popen = subprocess.Popen(
-            argv, stdout=sys.stdout, stderr=sys.stderr
+            argv, stdout=sys.stdout, stderr=sys.stderr, env=env
         )
         with self._lock:
             prev = self._instances.get((kind, instance_id))

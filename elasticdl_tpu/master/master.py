@@ -228,6 +228,11 @@ class Master:
                 envs[observability.OBS_DIR_ENV] = os.environ[
                     observability.OBS_DIR_ENV
                 ]
+            # Log identity/format follows the master into the pods so a
+            # chaos run's JSON logs correlate across roles.
+            for var in ("ELASTICDL_LOG_LEVEL", "ELASTICDL_LOG_FORMAT"):
+                if os.environ.get(var):
+                    envs[var] = os.environ[var]
             return K8sInstanceManager(
                 args.namespace,
                 args.job_name,

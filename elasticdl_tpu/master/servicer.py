@@ -193,6 +193,7 @@ class MasterServicer:
             job_failed=stats["job_failed"],
             records_done=stats["records_done"],
             tasks_recovered=stats.get("tasks_recovered", 0),
+            tasks_abandoned=stats.get("tasks_abandoned", 0),
             metrics_port=self._metrics_port,
         )
         if self._instance_manager is not None:

@@ -39,6 +39,10 @@ _RECOVERED = _REG.counter(
     "edl_tasks_recovered_total",
     "In-flight tasks requeued after worker death/timeouts",
 )
+_ABANDONED = _REG.counter(
+    "edl_tasks_abandoned_total",
+    "Tasks dropped after exhausting max_task_retries (fails the job)",
+)
 _TODO = _REG.gauge("edl_tasks_todo", "Tasks waiting for dispatch")
 _DOING = _REG.gauge("edl_tasks_doing", "Tasks currently in flight")
 _RECORDS = _REG.gauge(
@@ -118,6 +122,7 @@ class TaskDispatcher:
         self._task_durations = {}  # task_type -> deque of seconds (bounded)
         self._records_done = 0  # successful TRAINING records, for monitors
         self._tasks_recovered = 0  # cumulative, for the job-status RPC
+        self._tasks_abandoned = 0  # retry-exhausted drops, ditto
         self._eval_complete_callbacks = []
         self._tasks_done_callbacks = []
 
@@ -340,12 +345,14 @@ class TaskDispatcher:
                 task.retry_count += 1
                 if task.retry_count > self._max_task_retries:
                     logger.error(
-                        "Task %s failed %d times (last: %s); failing job",
+                        "Task %s failed %d times (last: %s); abandoning "
+                        "it and failing the job",
                         task,
                         task.retry_count,
                         err_message,
                     )
-                    self._job_failed = True
+                    self._abandon_locked(task, task_id, worker_id,
+                                         err_message)
                     emit_event(
                         "job_failed",
                         task_id=task_id,
@@ -400,7 +407,7 @@ class TaskDispatcher:
                 task.retry_count += 1
                 if task.retry_count > self._max_task_retries:
                     failed.append(task)
-                    self._job_failed = True
+                    self._abandon_locked(task, tid, owner_id, err_message)
                     self._todo.clear()
                 else:
                     self._todo.appendleft(task)
@@ -427,6 +434,25 @@ class TaskDispatcher:
                 owner_id,
                 err_message,
             )
+
+    def _abandon_locked(self, task, task_id, worker_id, err_message):
+        """A task's retry ladder is exhausted: count it LOUDLY (elasticity
+        event + counter + job-status field) and fail the job. A silently
+        vanishing task is the one failure mode a monitor can never
+        distinguish from slow progress."""
+        self._tasks_abandoned += 1
+        self._job_failed = True
+        _ABANDONED.inc()
+        emit_event(
+            "task_abandoned",
+            task_id=task_id,
+            worker=worker_id,
+            shard=task.shard_name,
+            start=task.start,
+            end=task.end,
+            retries=task.retry_count,
+            error=err_message[:200],
+        )
 
     def recover_tasks(self, worker_id):
         """Re-queue every in-flight task owned by a dead worker (reference
@@ -557,5 +583,6 @@ class TaskDispatcher:
                 "num_epochs": self._num_epochs,
                 "records_done": self._records_done,
                 "tasks_recovered": self._tasks_recovered,
+                "tasks_abandoned": self._tasks_abandoned,
                 "job_failed": self._job_failed,
             }

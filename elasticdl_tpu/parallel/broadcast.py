@@ -74,11 +74,20 @@ class BroadcastServer:
 def pull_state(coordinator_addr, variables_treedef, opt_treedef, timeout=30):
     """Pull rank-0 state. Returns (variables, opt_state, version) or None if
     the coordinator has no state yet."""
-    channel = rpc.build_channel(coordinator_addr)
+    import time as _time
+
+    # The readiness probe and the RPC share ONE `timeout` budget: a
+    # regrouping worker may dial the coordinator while it is itself still
+    # re-binding after an elastic event, and a probe that ate the whole
+    # budget must not buy the RPC a second one (that would double rejoin
+    # latency exactly in the elastic path).
+    start = _time.time()
+    channel = rpc.build_channel(coordinator_addr, ready_timeout=timeout)
     try:
         stub = rpc.Stub(channel, rpc.COLLECTIVE_SERVICE)
+        remaining = max(1.0, timeout - (_time.time() - start))
         model = stub.pull_model(
-            pb.PullDenseParametersRequest(), timeout=timeout
+            pb.PullDenseParametersRequest(), timeout=remaining
         )
         if model.version < 0:
             return None

@@ -8,10 +8,25 @@ in parallel; sparse grads are merged/deduplicated *before* the wire
 (ps_client.py:135-232).
 """
 
+import grpc
 import numpy as np
 
 from elasticdl_tpu.common import hash_utils, rpc, tensor_utils
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.observability import emit_event
+from elasticdl_tpu.observability.metrics import default_registry
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+logger = get_logger("worker.ps_client")
+
+_REG = default_registry()
+_DEGRADED = _REG.gauge(
+    "edl_ps_shards_degraded", "PS shards this worker currently sees as down"
+)
+_DROPPED_PUSHES = _REG.counter(
+    "edl_ps_grad_pushes_dropped_total",
+    "Per-shard gradient pushes dropped because the shard was unreachable",
+)
 
 
 class PSClient:
@@ -32,7 +47,14 @@ class PSClient:
         self.bf16_wire = wire_dtype == "bfloat16"
         self._addrs = list(ps_addrs)
         self._worker_id = worker_id
-        self._channels = [rpc.build_channel(a) for a in self._addrs]
+        # Readiness-probe all shards CONCURRENTLY, then build channels
+        # without re-probing: serial probing would cost num_dead * timeout
+        # at worker startup when shards are mid-relaunch, exactly when a
+        # relaunched worker should be back serving the healthy shards.
+        self._probe_ready_concurrently()
+        self._channels = [
+            rpc.build_channel(a, ready_timeout=0) for a in self._addrs
+        ]
         self._stubs = [
             rpc.Stub(ch, rpc.PSERVER_SERVICE) for ch in self._channels
         ]
@@ -41,10 +63,84 @@ class PSClient:
         # (only pushes touching it bump it), so "what have I already got"
         # must be tracked per shard, not as one global number.
         self._dense_versions = [-1] * self.num_ps
+        # Shard-failure awareness: a shard whose RPCs fail (after the rpc
+        # plane's retries) is marked degraded instead of crashing the
+        # worker. Degraded shards skip gradient pushes (async SGD absorbs
+        # the lost update), report as uninitialized on dense pulls (the
+        # trainer's re-seed path owns recovery), and flip back to healthy
+        # on the first successful call.
+        self._degraded = set()
+        # Shards whose last dense pull answered initialized=False (or was
+        # unreachable) — the targets a re-seed push actually needs; a
+        # full-fan-out re-seed would re-ship every healthy shard a model
+        # it ignores, on every backoff iteration of an outage.
+        self.unseeded_shards = set()
 
     def close(self):
         for ch in self._channels:
             ch.close()
+
+    def _probe_ready_concurrently(self):
+        import concurrent.futures
+
+        timeout = rpc.ready_timeout()
+        if timeout <= 0 or not self._addrs:
+            return
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=len(self._addrs)
+        ) as pool:
+            ready = list(
+                pool.map(
+                    lambda a: rpc.wait_channel_ready(a, timeout),
+                    self._addrs,
+                )
+            )
+        for ps_id, ok in enumerate(ready):
+            if not ok:
+                logger.warning(
+                    "PS shard %d (%s) not accepting connections after "
+                    "%.0fs; proceeding (retries/degradation take over)",
+                    ps_id,
+                    self._addrs[ps_id],
+                    timeout,
+                )
+
+    # ---------- shard health ----------
+
+    @property
+    def degraded_shards(self):
+        return set(self._degraded)
+
+    def _mark_degraded(self, ps_id, err):
+        if ps_id not in self._degraded:
+            self._degraded.add(ps_id)
+            _DEGRADED.set(len(self._degraded))
+            code = err.code() if hasattr(err, "code") else None
+            logger.warning(
+                "PS shard %d (%s) degraded: %s",
+                ps_id,
+                self._addrs[ps_id],
+                getattr(code, "name", code),
+            )
+            emit_event(
+                "ps_shard_degraded",
+                ps=ps_id,
+                addr=self._addrs[ps_id],
+                code=str(getattr(code, "name", code)),
+            )
+
+    def _mark_healthy(self, ps_id):
+        if ps_id in self._degraded:
+            self._degraded.discard(ps_id)
+            _DEGRADED.set(len(self._degraded))
+            logger.info(
+                "PS shard %d (%s) healthy again",
+                ps_id,
+                self._addrs[ps_id],
+            )
+            emit_event(
+                "ps_shard_recovered", ps=ps_id, addr=self._addrs[ps_id]
+            )
 
     # ---------- partitioning ----------
 
@@ -59,12 +155,25 @@ class PSClient:
 
     # ---------- model init / re-seed ----------
 
-    def push_model(self, dense_params, embedding_infos=None, version=0):
+    def push_model(self, dense_params, embedding_infos=None, version=0,
+                   only_shards=None):
         """Push each PS its shard of the dense params + all table infos
-        (first-worker init AND the PS-restart re-seed path)."""
+        (first-worker init AND the PS-restart re-seed path).
+
+        only_shards: restrict the fan-out to these ps_ids (the re-seed
+        path targets just the unseeded shards instead of re-shipping the
+        model to healthy ones that ignore it).
+
+        A shard that rejects the push (still down mid-relaunch) is marked
+        degraded and skipped — the next _sync_model re-seed retries it;
+        only when EVERY targeted shard fails does the error propagate
+        (nothing was seeded, so the caller cannot make progress). Returns
+        the set of shards seeded."""
         parts = self.partition_dense_names(dense_params)
         futures = []
         for ps_id, stub in enumerate(self._stubs):
+            if only_shards is not None and ps_id not in only_shards:
+                continue
             model = pb.Model(version=version)
             for name in parts.get(ps_id, []):
                 model.dense_parameters.append(
@@ -77,19 +186,42 @@ class PSClient:
                 )
             for info in embedding_infos or []:
                 model.embedding_table_infos.append(info)
-            futures.append(stub.push_model.future(model))
-        for f in futures:
-            f.result()
+            futures.append((ps_id, stub.push_model.future(model)))
+        seeded, last_err = set(), None
+        for ps_id, f in futures:
+            try:
+                f.result()
+            except grpc.RpcError as e:
+                last_err = e
+                self._mark_degraded(ps_id, e)
+                continue
+            self._mark_healthy(ps_id)
+            seeded.add(ps_id)
+        if not seeded and last_err is not None:
+            raise last_err
+        return seeded
 
     def push_embedding_table_infos(self, infos):
         model = pb.Model()
         model.embedding_table_infos.extend(infos)
         futures = [
-            stub.push_embedding_table_infos.future(model)
-            for stub in self._stubs
+            (ps_id, stub.push_embedding_table_infos.future(model))
+            for ps_id, stub in enumerate(self._stubs)
         ]
-        for f in futures:
-            f.result()
+        last_err, delivered = None, 0
+        for ps_id, f in futures:
+            try:
+                f.result()
+            except grpc.RpcError as e:
+                # A shard that misses the infos serves no embeddings; the
+                # re-seed path replays them (push_model carries the infos).
+                last_err = e
+                self._mark_degraded(ps_id, e)
+                continue
+            self._mark_healthy(ps_id)
+            delivered += 1
+        if not delivered and last_err is not None:
+            raise last_err
 
     # ---------- pulls ----------
 
@@ -102,7 +234,9 @@ class PSClient:
 
         Returns (all_initialized, max_version, {name: ndarray}); params is
         partial when some shard reported initialized=False (that shard needs
-        a re-seed via push_model)."""
+        a re-seed via push_model) OR was unreachable (marked degraded here;
+        the caller's re-seed/backoff loop owns recovery — a dense pull
+        blocks-with-backoff rather than crashing the worker)."""
         parts = self.partition_dense_names(names)
         futures = {
             ps_id: self._stubs[ps_id].pull_dense_parameters.future(
@@ -116,12 +250,22 @@ class PSClient:
         }
         params, initialized, max_version = {}, True, 0
         for ps_id, f in futures.items():
-            res = f.result()
+            try:
+                res = f.result()
+            except grpc.RpcError as e:
+                self._mark_degraded(ps_id, e)
+                initialized = False
+                self.unseeded_shards.add(ps_id)
+                self._dense_versions[ps_id] = -1
+                continue
+            self._mark_healthy(ps_id)
             if not res.initialized:
                 initialized = False
+                self.unseeded_shards.add(ps_id)
                 # Force a full re-pull from this shard once it comes back.
                 self._dense_versions[ps_id] = -1
                 continue
+            self.unseeded_shards.discard(ps_id)
             self._dense_versions[ps_id] = res.version
             max_version = max(max_version, res.version)
             wanted = set(parts.get(ps_id, []))
@@ -160,7 +304,16 @@ class PSClient:
         }
         out = None
         for ps_id, (positions, f) in futures.items():
-            values = tensor_utils.tensor_pb_to_ndarray(f.result())
+            try:
+                result = f.result()
+            except grpc.RpcError as e:
+                # Embedding rows are REQUIRED for this batch — no partial
+                # answer is usable. Mark the shard and raise; the worker's
+                # minibatch retry ladder re-pulls once the shard returns.
+                self._mark_degraded(ps_id, e)
+                raise
+            self._mark_healthy(ps_id)
+            values = tensor_utils.tensor_pb_to_ndarray(result)
             if values.dtype != np.float32 and not keep_wire_dtype:
                 values = values.astype(np.float32)
             if out is None:
@@ -182,14 +335,20 @@ class PSClient:
         else:
             first_page = 65536
         all_ids, all_values = [], []
-        for stub in self._stubs:
+        for ps_id, stub in enumerate(self._stubs):
             start, requested = 0, first_page
             while True:
-                res = stub.pull_embedding_table(
-                    pb.PullEmbeddingTableRequest(
-                        name=name, start_row=start, max_rows=requested
+                try:
+                    res = stub.pull_embedding_table(
+                        pb.PullEmbeddingTableRequest(
+                            name=name, start_row=start, max_rows=requested
+                        )
                     )
-                )
+                except grpc.RpcError as e:
+                    # Export needs every shard's rows; a partial table
+                    # would silently corrupt the exported model.
+                    self._mark_degraded(ps_id, e)
+                    raise
                 values, ids = tensor_utils.indexed_slices_pb_to_ndarrays(
                     res
                 )
@@ -254,21 +413,45 @@ class PSClient:
                     )
                 )
         futures = [
-            self._stubs[ps_id].push_gradients.future(
-                pb.PushGradientsRequest(
-                    gradients=m,
-                    learning_rate=learning_rate,
-                    worker_id_plus_one=(
-                        self._worker_id + 1 if self._worker_id >= 0 else 0
-                    ),
-                    batch_size=batch_size,
-                )
+            (
+                ps_id,
+                self._stubs[ps_id].push_gradients.future(
+                    pb.PushGradientsRequest(
+                        gradients=m,
+                        learning_rate=learning_rate,
+                        worker_id_plus_one=(
+                            self._worker_id + 1
+                            if self._worker_id >= 0
+                            else 0
+                        ),
+                        batch_size=batch_size,
+                    )
+                ),
             )
             for ps_id, m in shard_models.items()
         ]
         accepted, max_version = True, 0
-        for f in futures:
-            res = f.result()
+        delivered, last_err = 0, None
+        for ps_id, f in futures:
+            try:
+                res = f.result()
+            except grpc.RpcError as e:
+                # Degraded shard: drop its slice of this step's gradients
+                # (async SGD tolerates a lost update the same way it
+                # tolerates staleness) and keep the healthy shards'
+                # updates. The worker keeps training on work that doesn't
+                # need the dead shard.
+                last_err = e
+                self._mark_degraded(ps_id, e)
+                _DROPPED_PUSHES.inc()
+                continue
+            self._mark_healthy(ps_id)
+            delivered += 1
             accepted = accepted and res.accepted
             max_version = max(max_version, res.version)
+        if not delivered and last_err is not None:
+            # Every shard refused: no progress is being recorded anywhere;
+            # surface the failure so the retry ladder (and ultimately the
+            # master's task retry accounting) sees it.
+            raise last_err
         return accepted, max_version

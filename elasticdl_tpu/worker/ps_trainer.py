@@ -20,6 +20,9 @@ reference's train_with_local_model) — the next successful pull overwrites
 that local drift, so the PS remains the source of truth.
 """
 
+import os
+
+import grpc
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -106,6 +109,15 @@ class ParameterServerTrainer(JaxTrainer):
         )
         self._use_async = use_async
         self._max_push_retries = max_push_retries
+        # Budget for _sync_model's re-seed/backoff loop on a degraded
+        # shard before failing the minibatch up the retry ladder. The
+        # bound applies between attempts: one in-flight pull can still
+        # take up to its own rpc retry budget (deadline x attempts) on a
+        # TCP-accepting-but-wedged peer, so the worst case is this budget
+        # plus one pull's budget.
+        self._degraded_block_seconds = float(
+            os.environ.get("ELASTICDL_PS_DEGRADED_BLOCK_SECONDS", "20")
+        )
         self._param_names = None
         self._embedding_dims = {}  # table -> dim, derived at init
         # table -> module-scope path inside the edl_embedding collection
@@ -206,10 +218,20 @@ class ParameterServerTrainer(JaxTrainer):
             for name, dim in sorted(self._embedding_dims.items())
         ]
 
-    def _push_local_model(self):
+    def _push_local_model(self, only_unseeded=False):
+        """only_unseeded: re-seed fan-out targets just the shards the last
+        pull found uninitialized/unreachable — healthy shards would only
+        discard the re-shipped model, and an outage's backoff loop calls
+        this repeatedly."""
         named, _ = flatten_params(jax.device_get(self._variables["params"]))
+        only_shards = None
+        if only_unseeded and self._ps.unseeded_shards:
+            only_shards = set(self._ps.unseeded_shards)
         self._ps.push_model(
-            named, self._embedding_infos(), version=self._version
+            named,
+            self._embedding_infos(),
+            version=self._version,
+            only_shards=only_shards,
         )
 
     # ---------- PS sync ----------
@@ -246,20 +268,62 @@ class ParameterServerTrainer(JaxTrainer):
 
     def _sync_model(self):
         """Pull dense params; re-seed any uninitialized shard from local
-        weights (that IS the PS fault-tolerance path)."""
-        # The PSClient tracks per-shard pull cursors: a shard only re-sends
-        # params newer than this client's last pull from it.
-        initialized, version, named = self._ps.pull_dense_parameters(
-            self._param_names
-        )
-        if not initialized:
-            logger.info("Uninitialized PS shard found; re-seeding from local")
-            self._push_local_model()
+        weights (that IS the PS fault-tolerance path).
+
+        Dense pulls BLOCK with bounded backoff through a shard outage: an
+        unreachable shard reports as uninitialized (PSClient marks it
+        degraded instead of raising), this loop re-seeds + re-pulls with
+        growing sleeps until the shard answers or the budget runs out,
+        and only then raises — which hands recovery to the worker's
+        minibatch retry ladder and, past that, the master's task retries."""
+        import time as _time
+
+        deadline = _time.time() + self._degraded_block_seconds
+        backoff = 0.5
+        while True:
+            # The PSClient tracks per-shard pull cursors: a shard only
+            # re-sends params newer than this client's last pull from it.
             initialized, version, named = self._ps.pull_dense_parameters(
                 self._param_names
             )
-            if not initialized:
-                raise RuntimeError("PS still uninitialized after re-seed")
+            if initialized:
+                break
+            logger.info(
+                "Uninitialized/degraded PS shard found; re-seeding from "
+                "local (degraded=%s)",
+                sorted(self._ps.degraded_shards),
+            )
+            try:
+                self._push_local_model(only_unseeded=True)
+                initialized, version, named = (
+                    self._ps.pull_dense_parameters(self._param_names)
+                )
+                if initialized:
+                    break
+            except grpc.RpcError:
+                # Every shard refused the re-seed: still mid-outage; keep
+                # backing off until the budget runs out.
+                pass
+            if _time.time() >= deadline:
+                raise RuntimeError(
+                    "PS still uninitialized after re-seed (degraded "
+                    f"shards: {sorted(self._ps.degraded_shards)})"
+                )
+            _time.sleep(backoff)
+            backoff = min(backoff * 2, 4.0)
+        if version < self._version:
+            # Version consistency check for the relaunch path: a shard
+            # that came back BEHIND this worker was restored from an older
+            # checkpoint (or freshly re-seeded at a lower version). The PS
+            # owns the model version — adopt its clock so this worker's
+            # pushes don't arrive "from the future" forever.
+            logger.warning(
+                "PS model version regressed to %d (< local %d) — "
+                "checkpoint-restored shard; adopting the PS version",
+                version,
+                self._version,
+            )
+            self._version = version
         if named:
             self._variables["params"] = unflatten_like(
                 self._variables["params"],

@@ -9,7 +9,10 @@ reference needed a pending-task deque for becomes trivial, and a recovered
 task re-runs whole.
 """
 
+import os
 import time
+
+import grpc
 
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
@@ -17,6 +20,53 @@ from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 logger = get_logger("worker.task_data_service")
 
 _WAIT_SLEEP_SECONDS = 0.5
+# How long the task loop tolerates an unreachable master (restart, stall)
+# before letting the failure propagate and the worker exit. Each failed
+# poll already burned the rpc plane's per-call retry budget.
+_MASTER_PATIENCE_SECONDS = float(
+    os.environ.get("ELASTICDL_MASTER_PATIENCE_SECONDS", "120")
+)
+
+# Only CONNECTIVITY failures are worth riding out: a stalled or
+# restarting master must not kill every worker (one control-plane blip
+# would turn into a full fleet relaunch). Fail-fast statuses
+# (INVALID_ARGUMENT, INTERNAL, ...) are deterministic — re-sending the
+# same call for two minutes cannot fix them, matching the rpc plane's
+# own retryability classification.
+_CONNECTIVITY_CODES = (
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+)
+
+
+def _ride_master_outage(call, what, give_up=None):
+    """Run `call()`, re-trying through connectivity failures for up to the
+    patience window. On exhaustion: `give_up(error)` when provided (drop
+    semantics), else re-raise. Non-connectivity errors propagate
+    immediately."""
+    unreachable_since = None
+    while True:
+        try:
+            return call()
+        except grpc.RpcError as e:
+            code = e.code() if hasattr(e, "code") else None
+            if code not in _CONNECTIVITY_CODES:
+                raise
+            now = time.time()
+            if unreachable_since is None:
+                unreachable_since = now
+                logger.warning(
+                    "Master unreachable on %s (%s); holding on for up "
+                    "to %.0fs",
+                    what,
+                    getattr(code, "name", code),
+                    _MASTER_PATIENCE_SECONDS,
+                )
+            if now - unreachable_since > _MASTER_PATIENCE_SECONDS:
+                if give_up is None:
+                    raise
+                return give_up(e)
+            time.sleep(_WAIT_SLEEP_SECONDS * 2)
 
 
 class TaskDataService:
@@ -26,9 +76,12 @@ class TaskDataService:
 
     def get_task(self, task_type=pb.TRAINING, wait=True):
         """Next task from the master; blocks through WAIT states (queue
-        momentarily empty). Returns None when the job is finished."""
+        momentarily empty) and rides out transient master outages. Returns
+        None when the job is finished."""
         while True:
-            task = self._mc.get_task(task_type)
+            task = _ride_master_outage(
+                lambda: self._mc.get_task(task_type), "get_task"
+            )
             if task.task_id >= 0:
                 return task
             if task.type == pb.WAIT and wait:
@@ -61,7 +114,28 @@ class TaskDataService:
         return list(self._reader.read_records(lease_range))
 
     def report_task(self, task_id, err_message="", exec_counters=None):
-        self._mc.report_task_result(task_id, err_message, exec_counters)
+        """Report a task result, riding out a master outage the same way
+        get_task does. A report that never lands is SAFE to drop after the
+        patience window: the master's watchdog recovers the still-'doing'
+        task and re-dispatches it — whereas letting the error propagate
+        kills the worker and turns one control-plane blip into a relaunch."""
+
+        def dropped(e):
+            logger.warning(
+                "Dropping result report for task %d after %.0fs of "
+                "master unreachability; the watchdog will recover and "
+                "re-dispatch it",
+                task_id,
+                _MASTER_PATIENCE_SECONDS,
+            )
+
+        _ride_master_outage(
+            lambda: self._mc.report_task_result(
+                task_id, err_message, exec_counters
+            ),
+            "report_task_result",
+            give_up=dropped,
+        )
 
     @property
     def data_reader(self):

@@ -8,6 +8,8 @@ callback task handling.
 
 import traceback
 
+import grpc
+
 from elasticdl_tpu.common.constants import (
     DEFAULT_MAX_MINIBATCH_RETRY_NUM,
     JobType,
@@ -136,8 +138,18 @@ class Worker:
                 self._run_task(task, self._process_train_batch)
                 # In local/AllReduce modes the worker is the version source
                 # (the PS plays that role in PS mode): reporting after each
-                # training task drives version-triggered evaluation.
-                self._mc.report_version(self._trainer.get_model_version())
+                # training task drives version-triggered evaluation. A lost
+                # report only delays the next eval trigger — never worth a
+                # worker's life during a master blip.
+                try:
+                    self._mc.report_version(
+                        self._trainer.get_model_version()
+                    )
+                except grpc.RpcError:
+                    logger.warning(
+                        "report_version failed (master unreachable?); "
+                        "continuing",
+                    )
                 # Interleave pending evaluation tasks between training tasks
                 # (reference worker.py:343-349).
                 if self._job_type == JobType.TRAINING_WITH_EVALUATION:

@@ -1,0 +1,292 @@
+"""PS shard failover: degraded-shard tracking in PSClient, the trainer's
+bounded-backoff dense-pull behavior, checkpoint-restore version consistency,
+and torn-checkpoint rejection (ISSUE 2 tentpole part 2 + satellite)."""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import test_module
+from elasticdl_tpu.common import rpc
+from elasticdl_tpu.common.model_utils import get_model_spec
+from elasticdl_tpu.ops import optimizers
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.ps import checkpoint as ckpt
+from elasticdl_tpu.ps.parameter_server import ParameterServer
+from elasticdl_tpu.ps.parameters import Parameters
+from elasticdl_tpu.worker.ps_client import PSClient
+from elasticdl_tpu.worker.ps_trainer import ParameterServerTrainer
+
+
+@pytest.fixture(autouse=True)
+def _fast_rpc_config(monkeypatch):
+    """Shard-down paths burn the full retry budget per call; shrink it so
+    the suite stays fast, and shorten the breaker cooldown so restarted
+    shards are probed promptly."""
+    monkeypatch.setenv("ELASTICDL_RPC_BACKOFF_BASE", "0.01")
+    monkeypatch.setenv("ELASTICDL_RPC_BACKOFF_MAX", "0.05")
+    monkeypatch.setenv("ELASTICDL_RPC_MAX_ATTEMPTS", "2")
+    monkeypatch.setenv("ELASTICDL_RPC_BREAKER_COOLDOWN", "0.2")
+    rpc.reload_config()
+    yield
+    monkeypatch.undo()
+    rpc.reload_config()
+
+
+def _two_shards(**kw):
+    spec = optimizers.sgd(0.5)
+    return [
+        ParameterServer(i, 2, optimizer_spec=spec, **kw) for i in range(2)
+    ]
+
+
+def _dense():
+    # Enough names that both shards own some (name-hash partitioning).
+    return {
+        f"w{i}": np.full(4, float(i), np.float32) for i in range(8)
+    }
+
+
+def test_degraded_shard_pull_push_and_recovery():
+    servers = _two_shards()
+    try:
+        client = PSClient([s.addr for s in servers])
+        dense = _dense()
+        client.push_model(dense, version=0)
+        ok, _, params = client.pull_dense_parameters(list(dense))
+        assert ok and set(params) == set(dense)
+        parts = client.partition_dense_names(list(dense))
+        assert parts.get(0) and parts.get(1)  # both shards own names
+
+        # Shard 1 dies: dense pulls degrade instead of raising.
+        port1 = servers[1].port
+        servers[1].stop()
+        ok, _, params = client.pull_dense_parameters(list(dense))
+        assert not ok
+        assert client.degraded_shards == {1}
+        assert set(params) == set(parts[0])  # healthy shard still answers
+
+        # Gradient pushes keep training on the healthy shard: the dead
+        # shard's slice is dropped, no exception escapes.
+        grads = {name: np.full(4, 0.1, np.float32) for name in dense}
+        accepted, _ = client.push_gradients(grads, {}, version=0)
+        assert accepted
+        assert client.degraded_shards == {1}
+
+        # Shard 1 relaunches FRESH on the same addr (the local instance
+        # manager's relaunch shape): re-seed restores it and the client
+        # marks it healthy again.
+        servers[1] = ParameterServer(
+            1, 2, port=port1, optimizer_spec=optimizers.sgd(0.5)
+        )
+        # The channel needs a beat to reconnect (tuned reconnect backoff in
+        # GRPC_CHANNEL_OPTIONS caps this at fractions of a second).
+        import time
+
+        deadline = time.time() + 10
+        while client.degraded_shards and time.time() < deadline:
+            ok, _, _ = client.pull_dense_parameters(list(dense))
+            time.sleep(0.1)
+        assert not ok  # fresh shard: uninitialized, needs the re-seed
+        assert client.degraded_shards == set()
+        # The pull tracked exactly which shard needs seeding; a targeted
+        # re-seed touches only it (healthy shards would discard the push).
+        assert client.unseeded_shards == {1}
+        seeded = client.push_model(
+            dense, version=3, only_shards=client.unseeded_shards
+        )
+        assert seeded == {1}
+        ok, version, params = client.pull_dense_parameters(list(dense))
+        assert ok and set(params) >= set(parts[1])
+        assert servers[1].parameters.version == 3  # version carried over
+        client.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_all_shards_down_raises_on_push():
+    servers = _two_shards()
+    client = PSClient([s.addr for s in servers])
+    dense = _dense()
+    client.push_model(dense, version=0)
+    for s in servers:
+        s.stop()
+    import grpc
+
+    with pytest.raises(grpc.RpcError):
+        client.push_gradients(
+            {name: np.zeros(4, np.float32) for name in dense}, {}, version=0
+        )
+    assert client.degraded_shards == {0, 1}
+    # Dense pulls degrade without raising (the trainer's backoff loop owns
+    # the blocking).
+    ok, _, params = client.pull_dense_parameters(list(dense))
+    assert not ok and params == {}
+    client.close()
+
+
+def test_trainer_blocks_bounded_then_raises(monkeypatch):
+    monkeypatch.setenv("ELASTICDL_PS_DEGRADED_BLOCK_SECONDS", "1")
+    spec = get_model_spec("test_module")
+    server = ParameterServer(0, 1, optimizer_spec=spec.build_optimizer_spec())
+    trainer = ParameterServerTrainer(
+        spec.build_model(),
+        spec.loss,
+        spec.build_optimizer_spec(),
+        PSClient([server.addr]),
+        pipeline_pushes=False,
+    )
+    records = test_module.make_linear_records(64)
+    feats, labels = test_module.feed(records, "training", None)
+    trainer.init_variables_if_needed(feats)
+    trainer.train_minibatch(feats, labels)
+    server.stop()
+    import time
+
+    start = time.time()
+    with pytest.raises(RuntimeError, match="degraded"):
+        trainer._sync_model()
+    elapsed = time.time() - start
+    # Blocked with backoff (not an instant crash), but bounded (not
+    # forever): the worker's minibatch ladder takes over from here.
+    assert 1.0 <= elapsed < 30.0
+    trainer.close()
+
+
+def test_checkpoint_restore_version_regression_adopted(tmp_path):
+    """A PS relaunched from an older checkpoint rewinds the model version;
+    the trainer must adopt the PS clock instead of pushing 'from the
+    future' forever (the re-seed version consistency check)."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    spec = get_model_spec("test_module")
+    server = ParameterServer(
+        0,
+        1,
+        optimizer_spec=spec.build_optimizer_spec(),
+        checkpoint_dir=ckpt_dir,
+        checkpoint_steps=1,
+        keep_checkpoint_max=10,
+    )
+    port = server.port
+    trainer = ParameterServerTrainer(
+        spec.build_model(),
+        spec.loss,
+        spec.build_optimizer_spec(),
+        PSClient([server.addr]),
+        pipeline_pushes=False,
+    )
+    records = test_module.make_linear_records(64)
+    feats, labels = test_module.feed(records, "training", None)
+    trainer.init_variables_if_needed(feats)
+    for _ in range(5):
+        trainer.train_minibatch(feats, labels)
+    high_version = trainer.get_model_version()
+    assert high_version >= 5
+    server.stop()
+    # Keep only an OLDER complete version (simulates losing the newest
+    # checkpoints with the dead PS's disk).
+    versions = ckpt.list_checkpoint_versions(ckpt_dir)
+    keep = versions[1]
+    for version in versions:
+        if version != keep:
+            shutil.rmtree(os.path.join(ckpt_dir, f"version-{version}"))
+    server = ParameterServer(
+        0,
+        1,
+        port=port,
+        optimizer_spec=spec.build_optimizer_spec(),
+        checkpoint_dir_for_init=ckpt_dir,
+    )
+    try:
+        assert server.parameters.initialized
+        assert server.parameters.version == keep < high_version
+        trainer._sync_model()
+        assert trainer.get_model_version() == keep  # adopted the PS clock
+        # And training continues from there.
+        accepted, version, _ = trainer.train_minibatch(feats, labels)
+        assert accepted and version == keep + 1
+    finally:
+        trainer.close()
+        server.stop()
+
+
+# ---------- torn checkpoints (satellite) ----------
+
+
+def _save_version(ckpt_dir, version, num_ps, shard_ids, total_records=0):
+    for ps_id in shard_ids:
+        params = Parameters()
+        params.dense[f"w{ps_id}"] = np.full(3, float(version), np.float32)
+        params.total_records = total_records
+        ckpt.CheckpointSaver(
+            ckpt_dir, ps_id, num_ps, keep_checkpoint_max=10
+        ).save(version, params)
+
+
+def test_torn_checkpoint_rejected_and_fallback(tmp_path):
+    d = str(tmp_path)
+    _save_version(d, 1, 2, (0, 1), total_records=100)
+    # A kill mid-snapshot leaves a partial shard set for version 2.
+    _save_version(d, 2, 2, (0,), total_records=200)
+    assert ckpt.is_complete(d, 1)
+    assert not ckpt.is_complete(d, 2)
+    # Restore falls back to the previous COMPLETE version.
+    assert ckpt.latest_complete_version(d) == 1
+    # Explicitly restoring the torn version is rejected.
+    with pytest.raises(ValueError, match="incomplete"):
+        ckpt.restore_shard(d, 2, Parameters(), 0, 2)
+    # A PS bootstrapped from the dir restores version 1, not the torn 2.
+    ps = ParameterServer(
+        0, 2, optimizer_spec=optimizers.sgd(0.1),
+        checkpoint_dir_for_init=d,
+    )
+    try:
+        assert ps.parameters.initialized
+        assert ps.parameters.version == 1
+        assert ps.parameters.total_records == 100
+    finally:
+        ps.stop()
+
+
+def test_torn_checkpoint_restore_with_different_ps_count(tmp_path):
+    """The fallback version restores even when the job comes back with a
+    different shard count (reshard-on-load), and the torn version's partial
+    data is invisible to every new shard."""
+    d = str(tmp_path)
+    _save_version(d, 1, 2, (0, 1))
+    _save_version(d, 2, 2, (1,))  # torn
+    version = ckpt.latest_complete_version(d)
+    assert version == 1
+    restored = {}
+    for ps_id in range(3):  # 2 shards -> 3 shards
+        params = Parameters()
+        ckpt.restore_shard(d, version, params, ps_id, 3)
+        assert params.version == 1
+        for name, value in params.dense.items():
+            assert name not in restored
+            restored[name] = value
+            np.testing.assert_array_equal(value, np.full(3, 1.0))
+    assert set(restored) == {"w0", "w1"}  # nothing lost, nothing duplicated
+
+
+def test_partial_tmp_files_do_not_fake_completeness(tmp_path):
+    """The atomic-rename discipline means a crash can leave *.tmp litter;
+    completeness must key off final names only — and a shard-count mismatch
+    inside one version dir is torn, not complete."""
+    d = str(tmp_path)
+    _save_version(d, 3, 2, (0,))
+    vdir = os.path.join(d, "version-3")
+    with open(
+        os.path.join(vdir, "variables-1-of-2.ckpt.tmp"), "wb"
+    ) as f:
+        f.write(b"\x00garbage")
+    assert not ckpt.is_complete(d, 3)
+    assert ckpt.latest_complete_version(d) is None
+    # Mixed shard counts in one dir (a mis-configured relaunch wrote over
+    # the same version) must not read as complete either.
+    with open(os.path.join(vdir, "variables-1-of-3.ckpt"), "wb") as f:
+        f.write(pb.Model().SerializeToString())
+    assert not ckpt.is_complete(d, 3)

@@ -1,0 +1,473 @@
+"""The resilient RPC plane, proven deterministically without real processes:
+per-method deadlines, retry/backoff classification, circuit breaker state
+machine, channel-readiness wait, and the seeded chaos interceptors
+(docs/ROBUSTNESS.md matrix)."""
+
+import random
+import threading
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from elasticdl_tpu.chaos import FaultRule, FaultSchedule
+from elasticdl_tpu.common import rpc, tensor_utils
+from elasticdl_tpu.observability.metrics import default_registry
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+
+@pytest.fixture(autouse=True)
+def _fast_rpc_config(monkeypatch):
+    """Small backoffs so the retry suite runs in milliseconds; restore the
+    process-wide policy cache afterwards."""
+    monkeypatch.setenv("ELASTICDL_RPC_BACKOFF_BASE", "0.01")
+    monkeypatch.setenv("ELASTICDL_RPC_BACKOFF_MAX", "0.05")
+    rpc.reload_config()
+    yield
+    monkeypatch.undo()
+    rpc.reload_config()
+
+
+class FlakyPserver:
+    """Counts calls; fails the first `fail_n` of each method with `code`."""
+
+    def __init__(self, fail_n=0, code=grpc.StatusCode.UNAVAILABLE,
+                 sleep_s=0.0):
+        self.calls = {}
+        self.fail_n = fail_n
+        self.code = code
+        self.sleep_s = sleep_s
+
+    def _maybe_fail(self, method, context):
+        n = self.calls.get(method, 0)
+        self.calls[method] = n + 1
+        if self.sleep_s:
+            time.sleep(self.sleep_s)
+        if n < self.fail_n:
+            context.abort(self.code, f"flaky {method} #{n}")
+
+    def push_model(self, request, context):
+        self._maybe_fail("push_model", context)
+        return pb.Empty()
+
+    def push_embedding_table_infos(self, request, context):
+        self._maybe_fail("push_embedding_table_infos", context)
+        return pb.Empty()
+
+    def pull_dense_parameters(self, request, context):
+        self._maybe_fail("pull_dense_parameters", context)
+        return pb.PullDenseParametersResponse(
+            initialized=True,
+            version=7,
+            dense_parameters=[
+                tensor_utils.ndarray_to_tensor_pb(
+                    np.arange(64, dtype=np.float32), "w"
+                )
+            ],
+        )
+
+    def pull_embedding_vectors(self, request, context):
+        self._maybe_fail("pull_embedding_vectors", context)
+        return tensor_utils.ndarray_to_tensor_pb(
+            np.ones((2, 4), dtype=np.float32)
+        )
+
+    def pull_embedding_table(self, request, context):
+        self._maybe_fail("pull_embedding_table", context)
+        return pb.IndexedSlices()
+
+    def push_gradients(self, request, context):
+        self._maybe_fail("push_gradients", context)
+        return pb.PushGradientsResponse(accepted=True, version=8)
+
+
+def _counter_value(name, **labels):
+    metric = default_registry().get(name)
+    if metric is None:
+        return 0.0
+    child = metric.labels(**labels) if labels else metric
+    return child.value
+
+
+def _stub_to(port, **kw):
+    return rpc.Stub(
+        rpc.build_channel(f"127.0.0.1:{port}", **kw), rpc.PSERVER_SERVICE
+    )
+
+
+# ---------- retry policy ----------
+
+
+def test_backoff_sequence_is_deterministic_and_bounded():
+    policy = rpc.RetryPolicy(
+        backoff_base=0.1, backoff_multiplier=2.0, backoff_max=0.5,
+        jitter=0.5,
+    )
+    a = [policy.backoff(i, random.Random(42)) for i in range(6)]
+    b = [policy.backoff(i, random.Random(42)) for i in range(6)]
+    assert a == b  # same seed -> identical jittered sequence
+    for i, delay in enumerate(a):
+        full = min(0.5, 0.1 * 2.0**i)
+        assert 0.5 * full <= delay <= full  # jitter only shrinks
+
+def test_every_spec_method_has_a_policy():
+    for spec in (
+        rpc.MASTER_SERVICE, rpc.PSERVER_SERVICE, rpc.COLLECTIVE_SERVICE
+    ):
+        for method in spec.methods:
+            policy = rpc.METHOD_POLICIES[method]
+            assert policy.deadline > 0
+
+def test_push_gradients_does_not_retry_deadline():
+    # Non-idempotent: a timed-out push may have applied server-side.
+    policy = rpc.policy_for("/elasticdl_tpu.Pserver/push_gradients")
+    assert policy.retryable(grpc.StatusCode.UNAVAILABLE)
+    assert not policy.retryable(grpc.StatusCode.DEADLINE_EXCEEDED)
+
+def test_deadline_env_override(monkeypatch):
+    monkeypatch.setenv(
+        "ELASTICDL_RPC_DEADLINES", '{"get_task": 3.5}'
+    )
+    rpc.reload_config()
+    assert rpc.policy_for("get_task").deadline == 3.5
+    # Untouched methods keep their matrix defaults.
+    assert (
+        rpc.policy_for("push_model").deadline
+        == rpc.METHOD_POLICIES["push_model"].deadline
+    )
+
+
+# ---------- retries over a real in-process server ----------
+
+
+def test_retry_on_unavailable_then_success():
+    servicer = FlakyPserver(fail_n=2)
+    server, port = rpc.serve(servicer, rpc.PSERVER_SERVICE)
+    try:
+        before = _counter_value(
+            "edl_rpc_retries_total", method="push_model"
+        )
+        stub = _stub_to(port)
+        stub.push_model(pb.Model(version=1))
+        assert servicer.calls["push_model"] == 3  # 2 failures + success
+        after = _counter_value(
+            "edl_rpc_retries_total", method="push_model"
+        )
+        assert after - before == 2
+    finally:
+        server.stop(0)
+
+def test_future_path_retries_lazily():
+    servicer = FlakyPserver(fail_n=1)
+    server, port = rpc.serve(servicer, rpc.PSERVER_SERVICE)
+    try:
+        stub = _stub_to(port)
+        future = stub.pull_dense_parameters.future(
+            pb.PullDenseParametersRequest()
+        )
+        res = future.result()
+        assert res.initialized and res.version == 7
+        assert servicer.calls["pull_dense_parameters"] == 2
+    finally:
+        server.stop(0)
+
+def test_invalid_argument_fails_fast():
+    servicer = FlakyPserver(
+        fail_n=10**9, code=grpc.StatusCode.INVALID_ARGUMENT
+    )
+    server, port = rpc.serve(servicer, rpc.PSERVER_SERVICE)
+    try:
+        stub = _stub_to(port)
+        with pytest.raises(grpc.RpcError) as err:
+            stub.push_model(pb.Model())
+        assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert servicer.calls["push_model"] == 1  # no retries burned
+    finally:
+        server.stop(0)
+
+def test_deadline_exceeded_retries_then_raises(monkeypatch):
+    monkeypatch.setenv(
+        "ELASTICDL_RPC_DEADLINES", '{"pull_dense_parameters": 0.15}'
+    )
+    monkeypatch.setenv("ELASTICDL_RPC_MAX_ATTEMPTS", "3")
+    rpc.reload_config()
+    servicer = FlakyPserver(sleep_s=0.5)  # always slower than the deadline
+    server, port = rpc.serve(servicer, rpc.PSERVER_SERVICE)
+    try:
+        stub = _stub_to(port)
+        before = _counter_value(
+            "edl_rpc_retries_total", method="pull_dense_parameters"
+        )
+        with pytest.raises(grpc.RpcError) as err:
+            stub.pull_dense_parameters(pb.PullDenseParametersRequest())
+        assert err.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+        after = _counter_value(
+            "edl_rpc_retries_total", method="pull_dense_parameters"
+        )
+        assert after - before == 2  # 3 attempts = 2 retries
+    finally:
+        server.stop(0)
+
+def test_explicit_timeout_wins_over_policy_default():
+    servicer = FlakyPserver(sleep_s=0.4)
+    server, port = rpc.serve(servicer, rpc.PSERVER_SERVICE)
+    try:
+        stub = _stub_to(port)
+        start = time.time()
+        with pytest.raises(grpc.RpcError) as err:
+            # push_gradients: deadline not retryable, so one attempt.
+            stub.push_gradients(pb.PushGradientsRequest(), timeout=0.1)
+        assert err.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+        assert time.time() - start < 2.0
+    finally:
+        server.stop(0)
+
+
+# ---------- circuit breaker ----------
+
+
+def test_breaker_trips_fast_fails_and_half_opens(monkeypatch):
+    monkeypatch.setenv("ELASTICDL_RPC_BREAKER_THRESHOLD", "3")
+    monkeypatch.setenv("ELASTICDL_RPC_BREAKER_COOLDOWN", "0.3")
+    monkeypatch.setenv("ELASTICDL_RPC_MAX_ATTEMPTS", "1")
+    rpc.reload_config()
+    servicer = FlakyPserver(fail_n=10**9)
+    server, port = rpc.serve(servicer, rpc.PSERVER_SERVICE)
+    peer = f"127.0.0.1:{port}"
+    try:
+        stub = _stub_to(port)
+        for _ in range(3):
+            with pytest.raises(grpc.RpcError):
+                stub.push_model(pb.Model())
+        breaker = rpc.breaker_for(peer)
+        assert breaker.state == rpc.CircuitBreaker.OPEN
+        seen = servicer.calls["push_model"]
+        # Open circuit: the next call fails locally, the server sees
+        # nothing.
+        with pytest.raises(rpc.CircuitOpenError):
+            stub.push_model(pb.Model())
+        assert servicer.calls["push_model"] == seen
+        # Future-path fast-fail must yield a FAILED FUTURE, not raise at
+        # creation — PSClient's fan-out catches per-future errors, and a
+        # creation-time raise would escape its comprehension.
+        future = stub.push_model.future(pb.Model())
+        with pytest.raises(rpc.CircuitOpenError):
+            future.result()
+        assert servicer.calls["push_model"] == seen
+        # After the cooldown the breaker half-opens; a successful probe
+        # closes it again.
+        servicer.fail_n = 0
+        time.sleep(0.35)
+        stub.push_model(pb.Model())
+        assert breaker.state == rpc.CircuitBreaker.CLOSED
+    finally:
+        server.stop(0)
+
+def test_half_open_probe_with_answered_error_closes(monkeypatch):
+    """A half-open probe that gets a NON-connectivity status (the peer
+    answered — e.g. INTERNAL from a torn payload) must close the breaker,
+    not wedge it half-open forever."""
+    monkeypatch.setenv("ELASTICDL_RPC_BREAKER_THRESHOLD", "2")
+    monkeypatch.setenv("ELASTICDL_RPC_BREAKER_COOLDOWN", "0.2")
+    monkeypatch.setenv("ELASTICDL_RPC_MAX_ATTEMPTS", "1")
+    rpc.reload_config()
+    servicer = FlakyPserver(fail_n=2)  # 2 UNAVAILABLE, then healthy
+    server, port = rpc.serve(servicer, rpc.PSERVER_SERVICE)
+    breaker = rpc.breaker_for(f"127.0.0.1:{port}")
+    try:
+        stub = _stub_to(port)
+        for _ in range(2):
+            with pytest.raises(grpc.RpcError):
+                stub.push_model(pb.Model())
+        assert breaker.state == rpc.CircuitBreaker.OPEN
+        time.sleep(0.25)
+        servicer.code = grpc.StatusCode.INVALID_ARGUMENT
+        servicer.fail_n = 10**9
+        with pytest.raises(grpc.RpcError):
+            stub.push_model(pb.Model())  # the half-open probe: answered
+        assert breaker.state == rpc.CircuitBreaker.CLOSED
+        # ...and subsequent calls reach the wire (no fast-fail wedge).
+        seen = servicer.calls["push_model"]
+        with pytest.raises(grpc.RpcError) as err:
+            stub.push_model(pb.Model())
+        assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert servicer.calls["push_model"] == seen + 1
+    finally:
+        server.stop(0)
+
+def test_half_open_failure_reopens():
+    breaker = rpc.CircuitBreaker("test-peer", threshold=2, cooldown=0.1)
+    breaker.record_failure()
+    breaker.record_failure()
+    assert breaker.state == rpc.CircuitBreaker.OPEN
+    assert not breaker.allow()
+    time.sleep(0.12)
+    assert breaker.allow()  # half-open probe admitted
+    assert not breaker.allow()  # ...but only one at a time
+    breaker.record_failure()  # probe failed
+    assert breaker.state == rpc.CircuitBreaker.OPEN
+    time.sleep(0.12)
+    assert breaker.allow()
+    breaker.record_success()
+    assert breaker.state == rpc.CircuitBreaker.CLOSED
+
+
+# ---------- channel readiness ----------
+
+
+def test_wait_channel_ready_spans_a_late_bind():
+    port = 0
+    s = __import__("socket").socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    servicer = FlakyPserver()
+    started = {}
+
+    def bind_later():
+        time.sleep(0.5)
+        started["server"], _ = rpc.serve(
+            servicer, rpc.PSERVER_SERVICE, port=port
+        )
+
+    t = threading.Thread(target=bind_later)
+    t.start()
+    try:
+        start = time.time()
+        assert rpc.wait_channel_ready(f"127.0.0.1:{port}", timeout=10)
+        assert time.time() - start >= 0.4  # really waited for the bind
+        stub = _stub_to(port, ready_timeout=0)
+        stub.push_model(pb.Model())
+    finally:
+        t.join()
+        started["server"].stop(0)
+
+def test_wait_channel_ready_abort_check():
+    # A dead-on-arrival peer ends the wait early instead of burning the
+    # full timeout.
+    start = time.time()
+    assert not rpc.wait_channel_ready(
+        "127.0.0.1:1", timeout=30, abort_check=lambda: True
+    )
+    assert time.time() - start < 1.0
+
+
+# ---------- chaos injection ----------
+
+
+def test_fault_schedule_is_deterministic():
+    rules = [
+        {"method": "pull", "kind": "unavailable", "start": 1, "count": 2},
+        {"method": "", "kind": "latency", "latency_s": 0.2, "start": 3,
+         "count": 2, "side": "client"},
+    ]
+    calls = ["pull_a", "push_b", "pull_a", "pull_c", "push_b", "pull_a"]
+
+    def run():
+        schedule = FaultSchedule(rules, seed=99)
+        decisions, jitters = [], []
+        for method in calls:
+            for side in ("server", "client"):
+                for rule in schedule.decide(method, side):
+                    decisions.append((method, side, rule.kind))
+                    if rule.kind == "latency":
+                        jitters.append(schedule.jitter(rule))
+        return decisions, jitters
+
+    first, second = run(), run()
+    assert first == second  # byte-identical replay
+    decisions, jitters = first
+    # pull-matching server calls, in order: pull_a#0, pull_a#1, pull_c#2,
+    # pull_a#3; the [start=1, count=2) window covers exactly #1 and #2.
+    unavailable = [d for d in decisions if d[2] == "unavailable"]
+    assert unavailable == [
+        ("pull_a", "server", "unavailable"),
+        ("pull_c", "server", "unavailable"),
+    ]
+    assert all(0.1 <= j <= 0.3 for j in jitters)
+
+def test_chaos_rule_validation():
+    with pytest.raises(ValueError):
+        FaultRule(method="x", kind="explode")
+    with pytest.raises(ValueError):
+        FaultRule(method="x", kind="latency", side="middle")
+
+def test_chaos_schedule_env_roundtrip():
+    schedule = FaultSchedule(
+        [{"method": "get_task", "kind": "unavailable", "start": 2,
+          "count": 3, "side": "client"}],
+        seed=5,
+    )
+    restored = FaultSchedule.from_json(schedule.to_json())
+    assert restored.seed == 5
+    assert restored.rules == schedule.rules
+
+def test_chaos_server_unavailable_is_retried_through():
+    schedule = FaultSchedule(
+        [{"method": "pull_dense_parameters", "kind": "unavailable",
+          "start": 0, "count": 2}]
+    )
+    servicer = FlakyPserver()
+    server, port = rpc.serve(servicer, rpc.PSERVER_SERVICE, chaos=schedule)
+    try:
+        stub = _stub_to(port)
+        res = stub.pull_dense_parameters(pb.PullDenseParametersRequest())
+        assert res.version == 7  # the retry plane rode out the window
+        injected = _counter_value(
+            "edl_chaos_injected_total", kind="unavailable", side="server"
+        )
+        assert injected >= 2
+    finally:
+        server.stop(0)
+
+def test_chaos_client_unavailable_injection():
+    schedule = FaultSchedule(
+        [{"method": "push_model", "kind": "unavailable", "start": 0,
+          "count": 1, "side": "client"}]
+    )
+    servicer = FlakyPserver()
+    server, port = rpc.serve(servicer, rpc.PSERVER_SERVICE)
+    try:
+        stub = _stub_to(port, chaos=schedule)
+        stub.push_model(pb.Model())  # retry absorbs the injected fault
+        assert servicer.calls["push_model"] == 1  # wire saw only the retry
+    finally:
+        server.stop(0)
+
+def test_chaos_truncation_surfaces_as_failure_then_recovers():
+    schedule = FaultSchedule(
+        [{"method": "pull_dense_parameters", "kind": "truncate",
+          "start": 0, "count": 1}]
+    )
+    servicer = FlakyPserver()
+    server, port = rpc.serve(servicer, rpc.PSERVER_SERVICE, chaos=schedule)
+    try:
+        stub = _stub_to(port)
+        # Torn payload: fail-fast (INTERNAL — deterministic corruption must
+        # reach the caller's ladder, not burn rpc retries).
+        with pytest.raises(grpc.RpcError) as err:
+            stub.pull_dense_parameters(pb.PullDenseParametersRequest())
+        assert err.value.code() == grpc.StatusCode.INTERNAL
+        # The very next call is clean.
+        res = stub.pull_dense_parameters(pb.PullDenseParametersRequest())
+        assert res.version == 7
+    finally:
+        server.stop(0)
+
+def test_chaos_client_deadline_kind(monkeypatch):
+    monkeypatch.setenv("ELASTICDL_RPC_MAX_ATTEMPTS", "2")
+    rpc.reload_config()
+    schedule = FaultSchedule(
+        [{"method": "pull_dense_parameters", "kind": "deadline",
+          "start": 0, "count": 10, "side": "client"}]
+    )
+    servicer = FlakyPserver(sleep_s=0.2)
+    server, port = rpc.serve(servicer, rpc.PSERVER_SERVICE)
+    try:
+        stub = _stub_to(port, chaos=schedule)
+        with pytest.raises(grpc.RpcError) as err:
+            stub.pull_dense_parameters(pb.PullDenseParametersRequest())
+        assert err.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+    finally:
+        server.stop(0)

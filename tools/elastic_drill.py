@@ -1,31 +1,48 @@
-"""Elasticity drill: kill a worker mid-job, measure the rejoin.
+"""Elasticity drills: inject a fault into a REAL local job, measure recovery.
 
 The BASELINE third north-star metric is elastic rejoin time — how long a
 job takes to resume making progress after losing a worker (the reference's
 headline capability, benchmarked in docs/benchmark/report_cn.md:66-96 as
-elastic-vs-gang job time). This drill:
+elastic-vs-gang job time). This tool grew from that single drill into a
+chaos-scenario runner (docs/ROBUSTNESS.md keeps the catalog):
 
-1. starts a REAL `edl train` job (local_process backend) as a subprocess,
-2. polls the master's get_job_status RPC until training progresses,
-3. SIGKILLs one worker process mid-epoch,
-4. measures t(kill) -> t(records_done advances again with the worker back)
-   — the rejoin time: detection + task recovery + relaunch + re-init,
-5. waits for the job to finish and reports JSON on stdout.
+  worker-kill   SIGKILL a worker that provably owns an in-flight task;
+                assert task recovery + relaunch + rejoin (the original
+                drill, unchanged).
+  ps-flap       SIGKILL a parameter server mid-job; the workers must ride
+                the outage on the rpc retry plane, the master must relaunch
+                the PS, and the re-seed path must restore its shard.
+  rpc-brownout  no process dies: a seeded ELASTICDL_CHAOS schedule injects
+                UNAVAILABLE/latency faults into the job's own RPC plane;
+                the job must complete with nonzero rpc_retries_total.
+  master-stall  SIGSTOP the master (the `edl train` process) for several
+                seconds with shrunk control-plane deadlines; workers must
+                retry through the stall instead of hanging or dying.
 
-Usable standalone (`python tools/elastic_drill.py`), from the e2e test,
+Every scenario runs a real `edl train` job (local_process backend) as a
+subprocess, polls get_job_status, injects its fault once training
+provably progresses, drains to completion, scrapes rpc retry/breaker
+counters from each role's advertised /metrics endpoint, and checks for
+leftover processes at exit. Usable standalone
+(`python tools/elastic_drill.py --scenario ps-flap`), from the e2e tests,
 and from bench.py (which folds rejoin_s into the benchmark details).
 """
 
 import argparse
 import json
 import os
+import re
 import signal
 import socket
 import subprocess
 import sys
 import time
+import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SCENARIOS = ("worker-kill", "ps-flap", "rpc-brownout", "master-stall")
 
 
 def _free_port():
@@ -63,22 +80,109 @@ def free_coordinator_block(width=16, attempts=64):
     raise RuntimeError("no free coordinator port block found")
 
 
-def _find_worker_pid(worker_id, master_port, timeout=60):
-    """Pid of the worker subprocess (a python -m elasticdl_tpu.worker.main
-    child with our master port on its command line)."""
-    needle = f"--master_addr 127.0.0.1:{master_port}"
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        out = subprocess.run(
-            ["pgrep", "-af", "elasticdl_tpu.worker.main"],
-            capture_output=True,
-            text=True,
-        ).stdout
-        for line in out.splitlines():
-            if needle in line and f"--worker_id {worker_id}" in line:
-                return int(line.split()[0])
-        time.sleep(0.2)
-    raise RuntimeError(f"worker {worker_id} process not found")
+def scenario_env(scenario):
+    """Extra environment a scenario injects into the JOB's processes (the
+    drill process itself stays fault-free)."""
+    if scenario == "rpc-brownout":
+        # Seeded schedule, replayed identically by every rerun: server-side
+        # UNAVAILABLE windows on the PS data plane (long enough to exhaust
+        # one retry budget and exercise the degraded-shard re-seed path),
+        # latency on gradient pushes, and client-side UNAVAILABLE on the
+        # workers' task pulls.
+        schedule = {
+            "seed": 20260803,
+            "rules": [
+                {
+                    "method": "pull_dense_parameters",
+                    "kind": "unavailable",
+                    "start": 6,
+                    "count": 8,
+                    "side": "server",
+                },
+                {
+                    "method": "push_gradients",
+                    "kind": "latency",
+                    "latency_s": 0.1,
+                    "start": 4,
+                    "count": 30,
+                    "side": "server",
+                },
+                {
+                    "method": "get_task",
+                    "kind": "unavailable",
+                    "start": 5,
+                    "count": 6,
+                    "side": "client",
+                },
+            ],
+        }
+        return {"ELASTICDL_CHAOS": json.dumps(schedule)}
+    if scenario == "master-stall":
+        # Shrink the control-plane deadlines below the stall length so the
+        # workers' calls fail fast and RETRY through the stall (instead of
+        # parking inside one long deadline and proving nothing).
+        return {
+            "ELASTICDL_RPC_DEADLINES": json.dumps(
+                {
+                    "get_task": 3.0,
+                    "report_task_result": 3.0,
+                    "report_version": 3.0,
+                    "report_worker_liveness": 3.0,
+                }
+            )
+        }
+    return {}
+
+
+class MetricsScraper:
+    """Polls every advertised /metrics endpoint of a job and keeps the
+    per-role high-water mark of the rpc retry/breaker/chaos counters
+    (relaunched processes restart their counters at zero, so a plain last
+    read would undercount)."""
+
+    _COUNTERS = (
+        "edl_rpc_retries_total",
+        "edl_rpc_breaker_trips_total",
+        "edl_chaos_injected_total",
+    )
+
+    def __init__(self, obs_dir):
+        self._endpoints_dir = os.path.join(obs_dir, "endpoints")
+        self._high = {}  # (role, counter) -> max summed value seen
+
+    def scrape(self):
+        if not os.path.isdir(self._endpoints_dir):
+            return
+        for entry in os.listdir(self._endpoints_dir):
+            if not entry.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self._endpoints_dir, entry)) as f:
+                    port = json.load(f).get("port")
+                if not port:
+                    continue
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=1
+                ).read().decode()
+            except (OSError, ValueError):
+                continue  # endpoint mid-rewrite or process mid-restart
+            role = entry[: -len(".json")]
+            for counter in self._COUNTERS:
+                total = 0.0
+                for m in re.finditer(
+                    rf"^{counter}(?:{{[^}}]*}})? ([0-9.eE+-]+)$",
+                    body,
+                    re.M,
+                ):
+                    total += float(m.group(1))
+                key = (role, counter)
+                self._high[key] = max(self._high.get(key, 0.0), total)
+
+    def totals(self):
+        out = {}
+        for (_, counter), value in self._high.items():
+            out[counter] = out.get(counter, 0.0) + value
+        return {k: round(v, 3) for k, v in out.items()}
 
 
 def run_drill(
@@ -95,6 +199,9 @@ def run_drill(
     env_overrides=None,
     timeout=300,
     require_victim_task=True,
+    scenario="worker-kill",
+    obs_dir=None,
+    stall_seconds=8.0,
 ):
     """strategy: explicit --distribution_strategy name; default derives
     from num_ps (ParameterServerStrategy when PS shards are requested,
@@ -105,12 +212,18 @@ def run_drill(
     in-flight task (see the freeze loop below) so task recovery is
     deterministic. Disable for multi-host lease drills: a SIGSTOPped rank
     stalls the whole SPMD world's collectives, and those drills assert
-    rejoin, not per-task recovery."""
+    rejoin, not per-task recovery.
+
+    scenario: one of SCENARIOS; obs_dir enables the metrics scraper (and
+    is exported to the job as ELASTICDL_OBS_DIR when the caller didn't)."""
     import grpc
 
+    from elasticdl_tpu.chaos import process as chaos_process
     from elasticdl_tpu.common import rpc
     from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
+    if scenario not in SCENARIOS:
+        raise ValueError(f"unknown scenario {scenario!r}; one of {SCENARIOS}")
     port = _free_port()
     env = dict(os.environ)
     # Full control of the children's import path — do NOT append the
@@ -120,7 +233,11 @@ def run_drill(
     # the drill passes are silently ignored and every worker sees one
     # device instead of the virtual multi-chip world.
     env["PYTHONPATH"] = f"{REPO}:{model_zoo}"
+    env.update(scenario_env(scenario))
     env.update(env_overrides or {})
+    if obs_dir and "ELASTICDL_OBS_DIR" not in (env_overrides or {}):
+        env["ELASTICDL_OBS_DIR"] = obs_dir
+    scraper = MetricsScraper(obs_dir) if obs_dir else None
     train = subprocess.Popen(
         [
             sys.executable, "-m", "elasticdl_tpu.client.main", "train",
@@ -150,6 +267,7 @@ def run_drill(
         start_new_session=True,
     )
     result = {
+        "scenario": scenario,
         "completed": False,
         "killed_worker": None,
         "rejoin_s": None,
@@ -157,25 +275,17 @@ def run_drill(
         "records_done": None,
     }
     try:
-        # Only open the gRPC channel once the port actually accepts: a
-        # channel whose first connect attempt predates the subprocess
-        # server's bind can wedge in UNAVAILABLE on sandboxed/virtualized
-        # network stacks (observed with grpc 1.68 under the CI sandbox),
-        # and the whole drill then reads as "job never started".
-        bind_deadline = time.time() + timeout
-        while time.time() < bind_deadline:
-            if train.poll() is not None:
-                break
-            try:
-                probe = socket.create_connection(
-                    ("127.0.0.1", port), timeout=1
-                )
-                probe.close()
-                break
-            except OSError:
-                time.sleep(0.2)
+        # The channel-ready wait now lives in common/rpc (build_channel
+        # probes by default); the drill keeps its own probe loop only to
+        # abort early when the job process dies before ever binding.
+        rpc.wait_channel_ready(
+            f"127.0.0.1:{port}",
+            timeout,
+            abort_check=lambda: train.poll() is not None,
+        )
         stub = rpc.Stub(
-            rpc.build_channel(f"127.0.0.1:{port}"), rpc.MASTER_SERVICE
+            rpc.build_channel(f"127.0.0.1:{port}", ready_timeout=0),
+            rpc.MASTER_SERVICE,
         )
 
         def status(deadline):
@@ -198,108 +308,74 @@ def run_drill(
                 break
             time.sleep(0.2)
 
-        # The drill: SIGKILL worker 0 (preemption). When the caller wants
-        # the kill to provably strand recoverable work (require_victim_task),
-        # freeze the victim FIRST and only deliver the SIGKILL once the
-        # master shows it owning an in-flight task: tasks on this tiny
-        # model finish in milliseconds, so an unsynchronized kill can land
-        # in the report-done -> next-get_task window where the worker owns
-        # nothing — then there is nothing to recover and the drill's
-        # "Recovered" assertion is timing-flaky under host load (the exact
-        # round-4 full-suite failure). SIGSTOP makes the observation
-        # stable: a stopped worker can't complete the task out from under
-        # the check (a brief settle lets an already-in-flight report-done
-        # land before the ownership read).
-        victim = _find_worker_pid(0, port)
-        t_freeze = None
-        if require_victim_task:
-            freeze_deadline = time.time() + 30
+        if scenario == "worker-kill":
+            s = _do_worker_kill(
+                train, stub, status, s, port, result,
+                require_victim_task, chaos_process,
+            )
+        elif scenario == "ps-flap":
+            victim = chaos_process.kill_role("ps", 0, port)
+            result["killed_ps"] = victim
+            result["records_at_kill"] = int(s.records_done)
+            # The flap is complete once a REPLACEMENT PS process exists.
+            t_kill = time.time()
             try:
-                while True:
-                    # The master's detection clock starts when heartbeats
-                    # stop — at the SIGSTOP, not at the later SIGKILL; the
-                    # rejoin metric must be measured from here.
-                    t_freeze = time.time()
-                    os.kill(victim, signal.SIGSTOP)
-                    time.sleep(0.1)  # drain any in-flight report RPC
-                    fresh = status(time.time() + 10)
-                    if fresh is not None:
-                        s = fresh
-                    # Only a FRESH post-freeze observation proves the
-                    # victim holds recoverable work; a stale snapshot (or
-                    # an unreachable/drained master) must not satisfy the
-                    # gate — mark unobserved and kill anyway.
-                    if (
-                        fresh is not None
-                        and dict(fresh.worker_doing_tasks).get(0, 0) > 0
-                    ):
-                        break
-                    if fresh is None or time.time() > freeze_deadline:
-                        result["victim_task_observed"] = False
-                        break
-                    os.kill(victim, signal.SIGCONT)
-                    time.sleep(0.05)
-            except ProcessLookupError:
-                # The victim exited during a CONT window (e.g. the job
-                # drained): nothing left to freeze or prove.
-                result["victim_task_observed"] = False
-            result.setdefault("victim_task_observed", True)
-            result["status_at_kill"] = {
-                "todo": int(s.todo_tasks),
-                "doing": int(s.doing_tasks),
-                "worker_doing_tasks": dict(s.worker_doing_tasks),
-            }
-        try:
-            os.kill(victim, signal.SIGKILL)
-        except ProcessLookupError:
-            pass  # already gone; the relaunch checks below still apply
-        # Freeze-gated kills were last SIGSTOPped (never resumed) at
-        # t_freeze — the instant the worker went silent.
-        t_kill = t_freeze if t_freeze is not None else time.time()
-        result["killed_worker"] = victim
-        result["records_at_kill"] = int(s.records_done)
+                replacement = victim
+                while replacement == victim:
+                    replacement = chaos_process.find_role_pid(
+                        "ps", 0, port, timeout=60
+                    )
+                    time.sleep(0.1)
+                result["replacement_ps"] = replacement
+                result["ps_relaunch_s"] = round(time.time() - t_kill, 3)
+            except RuntimeError:
+                # Job drained (or failed) before the relaunch was
+                # observed: report it structurally, don't crash the drill.
+                result["replacement_ps"] = None
+        elif scenario == "master-stall":
+            result["records_at_kill"] = int(s.records_done)
+            result["stalled_s"] = stall_seconds
+            # The master runs inside the `edl train` process (local
+            # backend); freezing it stalls the whole control plane while
+            # workers and PS keep running.
+            chaos_process.stall(train.pid, stall_seconds)
+        # rpc-brownout: nothing to do here — the chaos schedule shipped in
+        # the environment is already injecting faults.
 
-        # Rejoin = the REPLACEMENT worker back in the job: a new worker-0
-        # process exists (detection + relaunch) and worker 0's last-seen
-        # age shows an RPC made AFTER the relaunch (its re-init + first
-        # task pull) — attributed per worker, so survivors' concurrent
-        # progress can't fake it.
-        try:
-            replacement = victim
-            while replacement == victim:
-                replacement = _find_worker_pid(0, port, timeout=60)
-                time.sleep(0.1)
-            result["replacement_worker"] = replacement
-            t_relaunch = time.time()
-            while True:
-                s = status(time.time() + 30)
-                if s is None or s.finished:
-                    break
-                age = dict(s.worker_last_seen_ago).get(0)
-                if age is not None and time.time() - age >= t_relaunch:
-                    result["rejoin_s"] = round(time.time() - t_kill, 3)
-                    break
-                time.sleep(0.1)
-        except RuntimeError:
-            pass  # job drained before the relaunch was observed
+        # Drain to completion, scraping metrics endpoints as we go.
+        drain_deadline = time.time() + timeout
+        while time.time() < drain_deadline:
+            if scraper is not None:
+                scraper.scrape()
+            s2 = status(time.time() + 10)
+            if s2 is None:
+                break
+            s = s2
+            if s.finished or s.job_failed:
+                break
+            time.sleep(0.3)
 
         train.wait(timeout=timeout)
         result["completed"] = train.returncode == 0
         out = train.stdout.read()
         result["relaunched"] = "Relaunching worker 0" in out
+        result["ps_relaunched"] = "Relaunching ps 0" in out
         result["recovered_tasks"] = "Recovered" in out
+        result["reseeded"] = (
+            "re-seeding from local" in out
+            or "Model initialized from worker push" in out
+        )
         # Mesh layouts the workers actually built (lets drills assert a
         # TP/ZeRO world really formed rather than silently falling back).
-        import re
-
         result["mesh_axes_seen"] = sorted(
             set(re.findall(r"Mesh axes: (\{[^}]*\})", out))
         )
         result["log_tail"] = out[-2000:]
-        # Final record count from the log is not available post-shutdown;
-        # report the last sampled figure.
         if s is not None:
             result["records_done"] = int(s.records_done)
+            result["tasks_abandoned"] = int(s.tasks_abandoned)
+        if scraper is not None:
+            result["metrics"] = scraper.totals()
         return result
     finally:
         if train.poll() is None:
@@ -308,6 +384,109 @@ def run_drill(
             os.killpg(os.getpgid(train.pid), signal.SIGKILL)
         except (ProcessLookupError, PermissionError, OSError):
             pass
+        # Zero-leftover invariant: nothing of this job may outlive the
+        # drill (an orphan wedged in a retry loop would poison later runs
+        # AND falsify "the job survived"). Record, then reap.
+        time.sleep(0.2)
+        leftovers = chaos_process.find_job_pids(port)
+        result["leftover_procs"] = [line for _, line in leftovers]
+        for pid, _ in leftovers:
+            chaos_process.deliver(pid, signal.SIGKILL)
+
+
+def _do_worker_kill(train, stub, status, s, port, result,
+                    require_victim_task, chaos_process):
+    """The original drill: SIGKILL worker 0 (preemption) and measure the
+    rejoin. Returns the last observed status."""
+    # When the caller wants the kill to provably strand recoverable work
+    # (require_victim_task), freeze the victim FIRST and only deliver the
+    # SIGKILL once the master shows it owning an in-flight task: tasks on
+    # this tiny model finish in milliseconds, so an unsynchronized kill
+    # can land in the report-done -> next-get_task window where the worker
+    # owns nothing — then there is nothing to recover and the drill's
+    # "Recovered" assertion is timing-flaky under host load (the exact
+    # round-4 full-suite failure). SIGSTOP makes the observation stable: a
+    # stopped worker can't complete the task out from under the check (a
+    # brief settle lets an already-in-flight report-done land before the
+    # ownership read).
+    victim = chaos_process.find_role_pid("worker", 0, port)
+    t_freeze = None
+    if require_victim_task:
+        freeze_deadline = time.time() + 30
+        try:
+            while True:
+                # The master's detection clock starts when heartbeats
+                # stop — at the SIGSTOP, not at the later SIGKILL; the
+                # rejoin metric must be measured from here.
+                t_freeze = time.time()
+                os.kill(victim, signal.SIGSTOP)
+                time.sleep(0.1)  # drain any in-flight report RPC
+                fresh = status(time.time() + 10)
+                if fresh is not None:
+                    s = fresh
+                # Only a FRESH post-freeze observation proves the victim
+                # holds recoverable work; a stale snapshot (or an
+                # unreachable/drained master) must not satisfy the gate —
+                # mark unobserved and kill anyway.
+                if (
+                    fresh is not None
+                    and dict(fresh.worker_doing_tasks).get(0, 0) > 0
+                ):
+                    break
+                if fresh is None or time.time() > freeze_deadline:
+                    result["victim_task_observed"] = False
+                    break
+                os.kill(victim, signal.SIGCONT)
+                time.sleep(0.05)
+        except ProcessLookupError:
+            # The victim exited during a CONT window (e.g. the job
+            # drained): nothing left to freeze or prove.
+            result["victim_task_observed"] = False
+        result.setdefault("victim_task_observed", True)
+        result["status_at_kill"] = {
+            "todo": int(s.todo_tasks),
+            "doing": int(s.doing_tasks),
+            "worker_doing_tasks": dict(s.worker_doing_tasks),
+        }
+    try:
+        os.kill(victim, signal.SIGKILL)
+    except ProcessLookupError:
+        pass  # already gone; the relaunch checks below still apply
+    # Freeze-gated kills were last SIGSTOPped (never resumed) at
+    # t_freeze — the instant the worker went silent.
+    t_kill = t_freeze if t_freeze is not None else time.time()
+    result["killed_worker"] = victim
+    result["records_at_kill"] = int(s.records_done)
+
+    # Rejoin = the REPLACEMENT worker back in the job: a new worker-0
+    # process exists (detection + relaunch) and worker 0's last-seen
+    # age shows an RPC made AFTER the relaunch (its re-init + first
+    # task pull) — attributed per worker, so survivors' concurrent
+    # progress can't fake it.
+    try:
+        replacement = victim
+        while replacement == victim:
+            replacement = chaos_process.find_role_pid(
+                "worker", 0, port, timeout=60
+            )
+            time.sleep(0.1)
+        result["replacement_worker"] = replacement
+        t_relaunch = time.time()
+        while True:
+            s2 = status(time.time() + 30)
+            if s2 is None:
+                break
+            s = s2
+            if s.finished:
+                break
+            age = dict(s.worker_last_seen_ago).get(0)
+            if age is not None and time.time() - age >= t_relaunch:
+                result["rejoin_s"] = round(time.time() - t_kill, 3)
+                break
+            time.sleep(0.1)
+    except RuntimeError:
+        pass  # job drained before the relaunch was observed
+    return s
 
 
 def main():
@@ -318,6 +497,24 @@ def main():
     p.add_argument("--num_workers", type=int, default=2)
     p.add_argument("--num_ps", type=int, default=1)
     p.add_argument("--num_epochs", type=int, default=8)
+    p.add_argument(
+        "--scenario",
+        default="worker-kill",
+        choices=SCENARIOS,
+        help="which fault to inject (docs/ROBUSTNESS.md catalog)",
+    )
+    p.add_argument(
+        "--obs_dir",
+        default="",
+        help="observability dir (enables the rpc-metrics scraper)",
+    )
+    p.add_argument("--stall_seconds", type=float, default=8.0)
+    p.add_argument(
+        "--expect_records",
+        type=int,
+        default=0,
+        help="fail unless records_done reaches this count",
+    )
     p.add_argument(
         "--strategy",
         default=None,
@@ -340,10 +537,16 @@ def main():
         num_ps=args.num_ps,
         num_epochs=args.num_epochs,
         strategy=args.strategy,
+        scenario=args.scenario,
+        obs_dir=args.obs_dir or None,
+        stall_seconds=args.stall_seconds,
     )
     result.pop("log_tail", None)
     print(json.dumps(result))
-    return 0 if result["completed"] else 1
+    ok = result["completed"] and not result["leftover_procs"]
+    if args.expect_records:
+        ok = ok and result.get("records_done") == args.expect_records
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":
