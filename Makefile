@@ -19,16 +19,25 @@ bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --smoke
 
 # Static invariants (no jax needed): every RPC method has a deadline
-# policy and no call site bypasses the retry/deadline interceptor plane.
+# policy, no call site bypasses the retry/deadline interceptor plane,
+# and the metric namespace stays coherent (edl_ prefix, counter
+# suffixes, no conflicting registrations).
 lint:
 	python tools/check_rpc_deadlines.py
+	python tools/check_metric_names.py
 
 # The chaos scenario suite (real multi-process jobs with injected faults;
 # docs/ROBUSTNESS.md catalog) under a hard wall-clock cap.
 chaos:
 	set -o pipefail; timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly
 
+# The observability acceptance drill: a real 2w+2PS job with one worker
+# slowed by role-targeted chaos latency; the master's aggregator must
+# flag it (edl_job_straggler + alert event + /api/summary).
+obs:
+	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/test_obs_aggregation.py -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly
+
 native:
 	@if [ -f elasticdl_tpu/native/Makefile ]; then $(MAKE) -C elasticdl_tpu/native; else echo "native kernels not present yet"; fi
 
-.PHONY: proto test verify bench-smoke lint chaos native
+.PHONY: proto test verify bench-smoke lint chaos obs native

@@ -55,12 +55,26 @@ class FaultRule:
     count: int = -1  # number of calls affected; -1 = unbounded
     latency_s: float = 0.25
     side: str = "server"  # "server" | "client"
+    # Target one process by its ELASTICDL_ROLE stamp: "" matches every
+    # process, "worker-0" exactly that instance, a trailing "*" matches
+    # the prefix ("worker-*" = all workers). Exact by default — a
+    # substring match would make "worker-1" also hit worker-10..19 and
+    # silently widen a single-straggler drill into a cohort.
+    role: str = ""
 
     def __post_init__(self):
         if self.kind not in KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if self.side not in ("server", "client"):
             raise ValueError(f"unknown fault side {self.side!r}")
+
+    def matches_role(self):
+        if not self.role:
+            return True
+        stamp = os.environ.get("ELASTICDL_ROLE", "")
+        if self.role.endswith("*"):
+            return stamp.startswith(self.role[:-1])
+        return stamp == self.role
 
 
 class FaultSchedule:
@@ -83,6 +97,8 @@ class FaultSchedule:
         with self._lock:
             for i, rule in enumerate(self.rules):
                 if rule.side != side or rule.method not in method:
+                    continue
+                if not rule.matches_role():
                     continue
                 index = self._counts[i]
                 self._counts[i] += 1

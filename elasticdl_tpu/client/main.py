@@ -263,14 +263,33 @@ def _zoo_push(args):
     return res.returncode
 
 
-def _top(args):
-    """Live job monitor: poll the master's job-status RPC and print one
-    status line per interval (the in-job analog of the reference's
-    pod-polling job monitor, k8s_job_monitor.py:94-207; throughput is
-    derived by diffing records_done between polls)."""
+def _top_summary_line(status, first_records, first_ts, now):
+    """The job-end summary: the edl_job_* aggregates a CI log should
+    keep — average throughput, straggler flags, abandoned tasks."""
+    rate = ""
+    if first_ts is not None and now > first_ts:
+        avg = (status.records_done - first_records) / (now - first_ts)
+        rate = f" avg={avg:.1f} rec/s"
+    stragglers = ",".join(status.stragglers) or "none"
+    return (
+        f"summary: records={status.records_done}{rate} "
+        f"stragglers={stragglers} "
+        f"abandoned={status.tasks_abandoned} "
+        f"recovered={status.tasks_recovered} "
+        f"alerts={status.alerts_fired}"
+        + (" FAILED" if status.job_failed else "")
+    )
+
+
+def _dash(args):
+    """Live terminal dashboard: job status from the master's RPC plus the
+    aggregator's /api/summary (throughput sparkline, per-worker step-time
+    bars, straggler flags, PS shard load, active alerts). --once renders
+    exactly one frame and exits — the non-interactive/test mode."""
     import time
 
     from elasticdl_tpu.common import rpc
+    from elasticdl_tpu.observability import dashboard
     from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
     import grpc
@@ -278,7 +297,88 @@ def _top(args):
     stub = rpc.Stub(
         rpc.build_channel(args.master_addr), rpc.MASTER_SERVICE
     )
+    host = args.master_addr.rsplit(":", 1)[0]
+    errors = 0
+    last_status = None
+    polls = 0
+    iterations = getattr(args, "iterations", 0)
+
+    def _bounded_exit():
+        # Bounded probe (same --iterations contract as edl top): a
+        # wedged-but-serving master must not hang CI forever — and a
+        # master never reached at all is still exit 2, not success.
+        if last_status is None:
+            print(
+                f"master {args.master_addr} unreachable", flush=True
+            )
+            return 2
+        return 1 if last_status.job_failed else 0
+
+    while True:
+        if iterations and polls >= iterations:
+            return _bounded_exit()
+        polls += 1
+        try:
+            status = stub.get_job_status(pb.GetJobStatusRequest())
+            errors = 0
+        except grpc.RpcError as e:
+            # The master stops serving right after the job ends (same
+            # race _top rides): a job last seen FINISHED must exit 0/1,
+            # not read as a master crash.
+            errors += 1
+            if args.once or errors >= 3:
+                if last_status is not None and last_status.finished:
+                    return 1 if last_status.job_failed else 0
+                print(
+                    f"master {args.master_addr} unreachable "
+                    f"({e.code().name})",
+                    flush=True,
+                )
+                return 2
+            time.sleep(args.interval)
+            continue
+        last_status = status
+        summary = {}
+        if status.metrics_port:
+            try:
+                summary = dashboard.fetch_summary(
+                    host, status.metrics_port
+                )
+            except (OSError, ValueError):
+                summary = {}  # aggregator still warming up
+        frame = dashboard.render(summary, status)
+        if args.once:
+            print(frame, flush=True)
+            return 1 if status.job_failed else 0
+        print(dashboard.CLEAR + frame, flush=True)
+        if status.finished or status.job_failed:
+            return 1 if status.job_failed else 0
+        if iterations and polls >= iterations:
+            return _bounded_exit()  # no dead sleep after the last frame
+        time.sleep(args.interval)
+
+
+def _top(args):
+    """Live job monitor: poll the master's job-status RPC and print one
+    status line per interval (the in-job analog of the reference's
+    pod-polling job monitor, k8s_job_monitor.py:94-207; throughput is
+    derived by diffing records_done between polls). --watch renders the
+    full dashboard instead of one-line updates."""
+    import time
+
+    from elasticdl_tpu.common import rpc
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    import grpc
+
+    if getattr(args, "watch", False):
+        args.once = False
+        return _dash(args)
+    stub = rpc.Stub(
+        rpc.build_channel(args.master_addr), rpc.MASTER_SERVICE
+    )
     prev_records, prev_ts = None, None
+    first_records, first_ts = None, None
     last_status = None
     errors = 0
     for _ in range(args.iterations) if args.iterations else iter(int, 1):
@@ -293,6 +393,12 @@ def _top(args):
                 time.sleep(args.interval)
                 continue
             if last_status is not None and last_status.finished:
+                print(
+                    _top_summary_line(
+                        last_status, first_records, first_ts, time.time()
+                    ),
+                    flush=True,
+                )
                 return 1 if last_status.job_failed else 0
             if last_status is not None:
                 # Lost the master mid-job: distinct exit code — a crashed
@@ -312,6 +418,8 @@ def _top(args):
                 )
             return 2
         errors = 0
+        if first_ts is None:
+            first_records, first_ts = status.records_done, time.time()
         if last_status is None and status.metrics_port:
             # One-time pointer at the master's Prometheus endpoint (same
             # host as the gRPC addr, different port).
@@ -345,6 +453,10 @@ def _top(args):
             elastic += f" abandoned={status.tasks_abandoned}"
         if status.membership_epoch:
             elastic += f" mepoch={status.membership_epoch}"
+        if status.stragglers:
+            elastic += f" stragglers={','.join(status.stragglers)}"
+        if status.alerts_fired:
+            elastic += f" alerts={status.alerts_fired}"
         print(
             f"epoch {status.epoch}/{status.num_epochs} "
             f"v{status.model_version} "
@@ -356,8 +468,18 @@ def _top(args):
             flush=True,
         )
         if status.finished or status.job_failed:
+            print(
+                _top_summary_line(
+                    status, first_records, first_ts, time.time()
+                ),
+                flush=True,
+            )
             return 1 if status.job_failed else 0
         time.sleep(args.interval)
+    # Iterations exhausted mid-job: a job last seen FAILED must still
+    # exit nonzero (CI wires `edl top` as the job's oracle).
+    if last_status is not None and last_status.job_failed:
+        return 1
     return 0
 
 
@@ -394,7 +516,7 @@ def main(argv=None):
     )
     top.add_argument(
         "command",
-        choices=["train", "evaluate", "predict", "zoo", "top",
+        choices=["train", "evaluate", "predict", "zoo", "top", "dash",
                  "tensorboard"],
     )
     ns, rest = top.parse_known_args(argv)
@@ -405,6 +527,23 @@ def main(argv=None):
         tb.add_argument("--port", type=int, default=6006)
         return _tensorboard(tb.parse_args(rest))
 
+    if ns.command == "dash":
+        dash = argparse.ArgumentParser("edl dash")
+        dash.add_argument("--master_addr", required=True)
+        dash.add_argument("--interval", type=float, default=2.0)
+        dash.add_argument(
+            "--once",
+            action="store_true",
+            help="render one frame and exit (non-interactive/CI mode)",
+        )
+        dash.add_argument(
+            "--iterations",
+            type=int,
+            default=0,
+            help="stop after N frames (0 = until the job ends)",
+        )
+        return _dash(dash.parse_args(rest))
+
     if ns.command == "top":
         monitor = argparse.ArgumentParser("edl top")
         monitor.add_argument("--master_addr", required=True)
@@ -414,6 +553,11 @@ def main(argv=None):
             type=int,
             default=0,
             help="stop after N polls (0 = until the job ends)",
+        )
+        monitor.add_argument(
+            "--watch",
+            action="store_true",
+            help="render the live dashboard instead of one-line updates",
         )
         return _top(monitor.parse_args(rest))
 

@@ -200,6 +200,7 @@ class Master:
         )
         self._server = None
         self.port = None
+        self.aggregator = None
         self.instance_manager = self._build_instance_manager(args)
 
     # ---------- instance manager wiring ----------
@@ -359,9 +360,27 @@ class Master:
             logger.info(
                 "Prometheus metrics on :%d/metrics", self.obs.metrics_port
             )
+        if self.obs.obs_dir:
+            # Job-level telemetry: scrape every advertised per-role
+            # endpoint, derive throughput/straggler/imbalance signals,
+            # re-export them as edl_job_* gauges + /api/summary, and run
+            # the alert rules. Needs the obs dir (endpoint discovery);
+            # without one there is nothing to aggregate.
+            from elasticdl_tpu.observability.aggregator import (
+                TelemetryAggregator,
+            )
+
+            self.aggregator = TelemetryAggregator(
+                self.obs.obs_dir, job=self.args.job_name
+            ).start()
+            if self.obs.exporter is not None:
+                self.obs.exporter.summary_provider = (
+                    self.aggregator.summary
+                )
         self.servicer.bind_job_context(
             instance_manager=self.instance_manager,
             metrics_port=self.obs.metrics_port,
+            aggregator=self.aggregator,
         )
         if self.instance_manager is not None:
             if self.args.num_ps:
@@ -496,6 +515,9 @@ class Master:
                 self.membership.remove_worker(worker_id)
 
     def stop(self):
+        if self.aggregator is not None:
+            self.aggregator.close()
+            self.aggregator = None
         if self.instance_manager is not None:
             self.instance_manager.stop()
         if self.metrics_service is not None:

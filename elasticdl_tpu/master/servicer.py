@@ -43,11 +43,15 @@ class MasterServicer:
         # and metrics endpoint exist only once the master is serving.
         self._instance_manager = None
         self._metrics_port = 0
+        self._aggregator = None
 
-    def bind_job_context(self, instance_manager=None, metrics_port=0):
+    def bind_job_context(
+        self, instance_manager=None, metrics_port=0, aggregator=None
+    ):
         """Late-bind job-status sources created after this servicer."""
         self._instance_manager = instance_manager
         self._metrics_port = metrics_port
+        self._aggregator = aggregator
 
     def _touch(self, worker_id):
         with self._lock:
@@ -200,6 +204,11 @@ class MasterServicer:
             res.relaunches = self._instance_manager.total_relaunches()
         if self._membership is not None:
             res.membership_epoch = self._membership.group_id
+        if self._aggregator is not None:
+            # Straggler flags + alert count from the telemetry
+            # aggregator, so `edl top` sees anomalies without scraping.
+            res.stragglers.extend(self._aggregator.stragglers())
+            res.alerts_fired = self._aggregator.alerts_fired()
         for wid, age in last_seen_ago.items():
             res.worker_last_seen_ago[wid] = age
         for wid, n in stats["doing_by_worker"].items():

@@ -135,6 +135,13 @@ def setup(role, job="", obs_dir=None, metrics_port=None, registry=None):
     return _handle
 
 
+def _scrape_host():
+    bind = os.environ.get("ELASTICDL_METRICS_HOST", "")
+    if bind and bind not in ("0.0.0.0", "::"):
+        return bind
+    return os.environ.get("MY_POD_IP", "127.0.0.1")
+
+
 def _advertise_endpoint(obs_dir, role, job, port):
     endpoints = os.path.join(obs_dir, "endpoints")
     os.makedirs(endpoints, exist_ok=True)
@@ -142,6 +149,17 @@ def _advertise_endpoint(obs_dir, role, job, port):
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(
-            {"role": role, "job": job, "pid": os.getpid(), "port": port}, f
+            {
+                "role": role,
+                "job": job,
+                "pid": os.getpid(),
+                "port": port,
+                # Scrape host for off-host monitors (the aggregator):
+                # an explicit non-wildcard bind address wins (the
+                # exporter only listens there), then the pod IP, then
+                # localhost.
+                "host": _scrape_host(),
+            },
+            f,
         )
     os.replace(tmp, path)  # atomic: readers never see a partial file

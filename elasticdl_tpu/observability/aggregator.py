@@ -1,0 +1,620 @@
+"""Job-level telemetry aggregation: the master watches *performance*.
+
+PR 1 gave every process its own /metrics endpoint; this module closes the
+loop. A TelemetryAggregator thread runs inside the master, discovers every
+per-role endpoint from `<obs_dir>/endpoints/*.json`, scrapes each /metrics
+on an interval, parses the exposition text back into samples
+(promtext.py), and keeps a bounded ring-buffer time-series store per
+(role, metric, labels). From the store it derives job-level signals:
+
+  records/s throughput (+ a short history for sparklines)
+  per-worker step-time mean/p50/p99/EWMA from the phase histograms
+  straggler scores (per-worker step latency vs. the fleet median)
+  PS shard push/pull byte rates and load-imbalance scores
+  task-queue drain rate and completion ETA
+  per-worker MFU (when the worker publishes its estimate)
+
+The signals are re-exported on the master's own registry as `edl_job_*`
+gauges (so one scrape of the master answers "who is slow" without fanning
+out), fed through the alert rules engine (alerts.py), and published as a
+JSON dict behind the exporter's /api/summary — the feed for `edl dash`.
+
+Scrape failures are expected steady-state noise (processes relaunch,
+endpoints rewrite) and only count `edl_job_scrape_errors_total`.
+"""
+
+import collections
+import json
+import math
+import os
+import statistics
+import threading
+import time
+import urllib.request
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.observability import alerts as alerts_mod
+from elasticdl_tpu.observability import promtext
+from elasticdl_tpu.observability.metrics import default_registry
+
+logger = get_logger("observability.aggregator")
+
+INTERVAL_ENV = "ELASTICDL_AGGREGATOR_INTERVAL"
+DEFAULT_INTERVAL = 2.0
+
+# Ring depth per series: at the default 2 s interval this is ~8.5 min of
+# history — enough for rate windows and dashboard sparklines, bounded
+# regardless of job length.
+SERIES_DEPTH = 256
+
+# Throughput/step-time rates are computed over a sliding window of this
+# many seconds (at least two scrapes apart).
+RATE_WINDOW_S = 20.0
+
+_EWMA_ALPHA = 0.3
+
+# Minimum windowed step-count before a worker's latency participates in
+# straggler scoring: one slow compile must not flag a healthy worker.
+MIN_STEP_SAMPLES = 3
+
+
+class SeriesStore:
+    """Bounded (role, metric, labels) -> deque[(ts, value)] store."""
+
+    def __init__(self, depth=SERIES_DEPTH):
+        self._depth = depth
+        self._series = {}
+
+    def add(self, role, name, labels, value, ts):
+        key = (role, name, tuple(sorted(labels)))
+        series = self._series.get(key)
+        if series is None:
+            series = collections.deque(maxlen=self._depth)
+            self._series[key] = series
+        series.append((ts, value))
+
+    def latest(self, role, name, labels=()):
+        series = self._series.get((role, name, tuple(sorted(labels))))
+        return series[-1][1] if series else None
+
+    def rate(self, role, name, labels=(), window_s=RATE_WINDOW_S,
+             now=None):
+        """(newest - oldest-within-window) / dt, or None with < 2 points.
+        Counter resets (process relaunch) clamp to None for the window.
+        With `now`, a series whose newest point is older than the window
+        is STALE (the process stopped reporting) and answers None — a
+        dead worker's last numbers must age out, not freeze."""
+        series = self._series.get((role, name, tuple(sorted(labels))))
+        if not series or len(series) < 2:
+            return None
+        t_new, v_new = series[-1]
+        if now is not None and t_new < now - window_s:
+            return None
+        # The loop always binds: series[-1] itself satisfies the cutoff.
+        t_old = v_old = None
+        for ts, value in series:
+            if ts >= t_new - window_s:
+                t_old, v_old = ts, value
+                break
+        if t_old is None or t_new <= t_old:
+            return None
+        if v_new < v_old:
+            return None  # reset mid-window
+        return (v_new - v_old) / (t_new - t_old)
+
+    def delta(self, role, name, labels=(), window_s=RATE_WINDOW_S,
+              now=None):
+        series = self._series.get((role, name, tuple(sorted(labels))))
+        if not series or len(series) < 2:
+            return None
+        t_new, v_new = series[-1]
+        if now is not None and t_new < now - window_s:
+            return None  # stale series (see rate())
+        v_old = None
+        for ts, value in series:
+            if ts >= t_new - window_s:
+                v_old = value
+                break
+        if v_old is None or v_new < v_old:
+            return None
+        return v_new - v_old
+
+    def roles(self):
+        return sorted({role for role, _, _ in self._series})
+
+    def labelsets(self, role, name):
+        """Label tuples of every stored series of one (role, family) —
+        the query surface for family-wide sums (keeps callers off the
+        internal key layout)."""
+        return [
+            labels
+            for (s_role, s_name, labels) in list(self._series)
+            if s_role == role and s_name == name
+        ]
+
+
+def skew_scores(values, min_subjects=2):
+    """{subject: value} -> {subject: value / fleet median}; empty when
+    fewer than min_subjects report or the median is degenerate. The
+    straggler and PS-imbalance signals are both this shape.
+
+    median_low, not median: with an even fleet the interpolating median
+    averages the two middle values, so in the smallest elastic world (2
+    workers) one straggler drags the baseline up with it and its score
+    asymptotes to 2.0 — the default threshold would be unreachable
+    exactly where the drill runs. The low median keeps the baseline on a
+    healthy member."""
+    vals = {
+        k: v
+        for k, v in values.items()
+        if v is not None and v > 0 and math.isfinite(v)
+    }
+    if len(vals) < min_subjects:
+        return {}
+    median = statistics.median_low(sorted(vals.values()))
+    if median <= 0:
+        return {}
+    return {k: v / median for k, v in vals.items()}
+
+
+def histogram_quantile(bounds_counts, q):
+    """Estimate a quantile from cumulative histogram buckets.
+
+    bounds_counts: [(upper_bound, cumulative_count)] sorted by bound,
+    +Inf last. Returns the first bound whose cumulative count covers
+    q * total (Prometheus-style upper-bound estimate; the +Inf bucket
+    answers with the largest finite bound)."""
+    if not bounds_counts:
+        return None
+    total = bounds_counts[-1][1]
+    if total <= 0:
+        return None
+    target = q * total
+    prev_finite = None
+    for bound, cumulative in bounds_counts:
+        if math.isfinite(bound):
+            prev_finite = bound
+        if cumulative >= target:
+            return bound if math.isfinite(bound) else prev_finite
+    return prev_finite
+
+
+class TelemetryAggregator:
+    """Background scrape/derive/export loop in the master process."""
+
+    def __init__(
+        self,
+        obs_dir,
+        registry=None,
+        job="",
+        interval=None,
+        alert_engine=None,
+        scrape_timeout=1.0,
+    ):
+        self._obs_dir = obs_dir
+        self._endpoints_dir = os.path.join(obs_dir, "endpoints")
+        self._registry = registry or default_registry()
+        self._job = job
+        if interval is None:
+            try:
+                interval = float(
+                    os.environ.get(INTERVAL_ENV, "") or DEFAULT_INTERVAL
+                )
+            except ValueError:
+                interval = DEFAULT_INTERVAL
+        self.interval = max(0.2, interval)
+        self._scrape_timeout = scrape_timeout
+        self.store = SeriesStore()
+        self.engine = alert_engine or alerts_mod.AlertEngine(
+            registry=self._registry
+        )
+        self._straggler_skew = alerts_mod.straggler_skew_threshold()
+        self._lock = threading.Lock()
+        self._summary = {"job": job, "ts": None}
+        self._ewma = {}  # worker role -> EWMA step seconds
+        self._gauged_workers = set()  # roles with exported per-worker gauges
+        self._throughput_history = collections.deque(maxlen=60)
+        self._stop = threading.Event()
+        self._thread = None
+
+        reg = self._registry
+        self._g_rps = reg.gauge(
+            "edl_job_records_per_second",
+            "Job-level training throughput (aggregated by the master)",
+        )
+        self._g_step = reg.gauge(
+            "edl_job_step_seconds",
+            "Per-worker step latency stats derived from scraped phase "
+            "histograms",
+            labelnames=("worker", "stat"),
+        )
+        self._g_straggler = reg.gauge(
+            "edl_job_straggler",
+            "1 while the worker is flagged as a straggler",
+            labelnames=("worker",),
+        )
+        self._g_straggler_score = reg.gauge(
+            "edl_job_straggler_score",
+            "Worker step latency / fleet median",
+            labelnames=("worker",),
+        )
+        self._g_ps_bps = reg.gauge(
+            "edl_job_ps_bytes_per_second",
+            "Per-PS-shard gradient/parameter byte rates",
+            labelnames=("shard", "direction"),
+        )
+        self._g_ps_ratio = reg.gauge(
+            "edl_job_ps_load_ratio",
+            "PS shard byte rate / fleet median",
+            labelnames=("shard",),
+        )
+        self._g_eta = reg.gauge(
+            "edl_job_task_eta_seconds",
+            "Estimated seconds until the task queue drains",
+        )
+        self._g_drain = reg.gauge(
+            "edl_job_task_drain_per_second",
+            "Task completions per second (windowed)",
+        )
+        self._g_mfu = reg.gauge(
+            "edl_job_mfu",
+            "Per-worker model FLOPs utilization estimate (re-exported)",
+            labelnames=("worker",),
+        )
+        self._g_workers = reg.gauge(
+            "edl_job_workers_reporting",
+            "Worker endpoints scraped successfully on the last pass",
+        )
+        self._c_scrapes = reg.counter(
+            "edl_job_scrapes_total",
+            "Aggregator endpoint scrapes, by role",
+            labelnames=("role",),
+        )
+        self._c_scrape_errors = reg.counter(
+            "edl_job_scrape_errors_total",
+            "Aggregator scrapes that failed (endpoint mid-restart, ...)",
+            labelnames=("role",),
+        )
+
+    # ---------- lifecycle ----------
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run, name="edl-telemetry-aggregator", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def close(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:
+                logger.warning("Aggregation pass failed", exc_info=True)
+            self._stop.wait(self.interval)
+
+    # ---------- scraping ----------
+
+    def _discover_endpoints(self):
+        endpoints = []
+        try:
+            entries = os.listdir(self._endpoints_dir)
+        except OSError:
+            return endpoints
+        for entry in sorted(entries):
+            if not entry.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self._endpoints_dir, entry)) as f:
+                    info = json.load(f)
+            except (OSError, ValueError):
+                continue  # mid-rewrite; next pass sees it whole
+            if info.get("port"):
+                endpoints.append(info)
+        return endpoints
+
+    def _scrape(self, info):
+        host = info.get("host") or "127.0.0.1"
+        url = f"http://{host}:{info['port']}/metrics"
+        return (
+            urllib.request.urlopen(url, timeout=self._scrape_timeout)
+            .read()
+            .decode()
+        )
+
+    def poll_once(self, now=None):
+        """One scrape + derive + export pass (the thread's body; callable
+        directly from tests and `edl dash --once` style flows). Without
+        an explicit `now`, each endpoint's samples are stamped when they
+        were actually read — endpoints scrape sequentially with a
+        per-endpoint timeout, and a wedged peer must not skew the rate
+        denominators of everyone scraped after it."""
+        live = now is None
+        scraped = set()
+        for info in self._discover_endpoints():
+            role = info.get("role", "")
+            if role == "master" and info.get("pid") == os.getpid():
+                continue  # own registry is read in-process below
+            try:
+                text = self._scrape(info)
+            except (OSError, ValueError):
+                self._c_scrape_errors.labels(role=role or "?").inc()
+                continue
+            ts = time.time() if live else now
+            if self._ingest(role, text, ts):
+                scraped.add(role)
+                self._c_scrapes.labels(role=role or "?").inc()
+        # The master's own registry never travels over HTTP: reading it
+        # in-process keeps master-side signals alive even when its
+        # exporter could not bind a port.
+        now = time.time() if live else now
+        if self._ingest("master", self._registry.expose(), now):
+            scraped.add("master")
+            self._c_scrapes.labels(role="master").inc()
+        self._derive(now, scraped)
+
+    def _ingest(self, role, text, now):
+        """Parse + store one payload; False (and a scrape-error count)
+        when the text does not parse — a corrupt endpoint must not be
+        reported as healthy."""
+        try:
+            families = promtext.parse(text)
+        except promtext.ParseError:
+            self._c_scrape_errors.labels(role=role or "?").inc()
+            return False
+        for family in families.values():
+            # The aggregator's own edl_job_* output must not feed back
+            # into its input when it ingests the master registry.
+            if family.name.startswith("edl_job_"):
+                continue
+            for sample in family.samples:
+                self.store.add(
+                    role, sample.name, sample.labels, sample.value, now
+                )
+        return True
+
+    # ---------- derivation ----------
+
+    def _worker_roles(self):
+        return [r for r in self.store.roles() if r.startswith("worker")]
+
+    def _ps_roles(self):
+        return [r for r in self.store.roles() if r.startswith("ps")]
+
+    def _step_labels(self):
+        return (("phase", "batch_process"),)
+
+    def _worker_step_stats(self, role, now=None):
+        """Windowed step-time stats for one worker from its scraped
+        edl_phase_seconds{phase="batch_process"} histogram."""
+        labels = self._step_labels()
+        dsum = self.store.delta(
+            role, "edl_phase_seconds_sum", labels, now=now
+        )
+        dcount = self.store.delta(
+            role, "edl_phase_seconds_count", labels, now=now
+        )
+        if not dcount or dsum is None or dcount < MIN_STEP_SAMPLES:
+            return None
+        mean = dsum / dcount
+        bounds = []
+        for s_labels in self.store.labelsets(
+            role, "edl_phase_seconds_bucket"
+        ):
+            label_map = dict(s_labels)
+            if label_map.get("phase") != "batch_process":
+                continue
+            le = label_map.get("le", "")
+            bound = math.inf if le == "+Inf" else float(le)
+            delta = self.store.delta(
+                role, "edl_phase_seconds_bucket", s_labels, now=now
+            )
+            if delta is not None:
+                bounds.append((bound, delta))
+        bounds.sort(key=lambda bc: bc[0])
+        p50 = histogram_quantile(bounds, 0.50)
+        p99 = histogram_quantile(bounds, 0.99)
+        ewma = self._ewma.get(role)
+        ewma = (
+            mean
+            if ewma is None
+            else _EWMA_ALPHA * mean + (1 - _EWMA_ALPHA) * ewma
+        )
+        self._ewma[role] = ewma
+        return {
+            "mean": mean,
+            "p50": p50,
+            "p99": p99,
+            "ewma": ewma,
+            "steps_in_window": dcount,
+        }
+
+    def _derive(self, now, scraped):
+        # --- throughput ---
+        rps = self.store.rate("master", "edl_records_done", now=now)
+        if rps is not None:
+            self._g_rps.set(rps)
+            self._throughput_history.append(
+                (round(now, 3), round(rps, 3))
+            )
+        records_done = self.store.latest("master", "edl_records_done")
+
+        # --- per-worker step time + stragglers ---
+        workers = {}
+        step_means = {}
+        for role in self._worker_roles():
+            stats = self._worker_step_stats(role, now)
+            if stats is None:
+                continue
+            workers[role] = stats
+            step_means[role] = stats["ewma"]
+            for stat in ("mean", "p50", "p99", "ewma"):
+                value = stats[stat]
+                if value is not None:
+                    self._g_step.labels(worker=role, stat=stat).set(value)
+            mfu = self.store.latest(role, "edl_worker_mfu")
+            if mfu is not None:
+                workers[role]["mfu"] = mfu
+                self._g_mfu.labels(worker=role).set(mfu)
+        straggler_scores = skew_scores(step_means)
+        for role, score in straggler_scores.items():
+            self._g_straggler_score.labels(worker=role).set(score)
+            workers[role]["straggler_score"] = round(score, 3)
+
+        # --- PS shard load ---
+        ps = {}
+        ps_rates = {}
+        for role in self._ps_roles():
+            # Per-shard byte counters carry labels (shard, rpc): fold
+            # every labeled series of the family into one per-role rate.
+            push = self._family_rate(
+                role, "edl_ps_push_bytes_total", now=now
+            )
+            pull = self._family_rate(
+                role, "edl_ps_pull_bytes_total", now=now
+            )
+            if push is None and pull is None:
+                continue
+            ps[role] = {
+                "push_bytes_per_second": push,
+                "pull_bytes_per_second": pull,
+            }
+            ps_rates[role] = (push or 0.0) + (pull or 0.0)
+            if push is not None:
+                self._g_ps_bps.labels(shard=role, direction="push").set(
+                    push
+                )
+            if pull is not None:
+                self._g_ps_bps.labels(shard=role, direction="pull").set(
+                    pull
+                )
+        ps_skew = skew_scores(ps_rates)
+        for role, ratio in ps_skew.items():
+            self._g_ps_ratio.labels(shard=role).set(ratio)
+            ps[role]["load_ratio"] = round(ratio, 3)
+
+        # --- task queue drain / ETA ---
+        todo = self.store.latest("master", "edl_tasks_todo")
+        doing = self.store.latest("master", "edl_tasks_doing")
+        # Success reports only: failed tasks are requeued, so counting
+        # them as drain would make the ETA optimistic exactly during the
+        # incidents this dashboard diagnoses.
+        drain = self.store.rate(
+            "master",
+            "edl_tasks_reported_total",
+            (("result", "success"),),
+            now=now,
+        )
+        eta = None
+        if drain and todo is not None:
+            eta = (todo + (doing or 0)) / drain
+            self._g_eta.set(eta)
+        if drain is not None:
+            self._g_drain.set(drain)
+        abandoned = self._family_total(
+            "master", "edl_tasks_abandoned_total"
+        )
+        recovered = self._family_total(
+            "master", "edl_tasks_recovered_total"
+        )
+
+        # --- alerts ---
+        signals = {
+            "records_per_second": rps,
+            "records_done": records_done,
+            "straggler_scores": straggler_scores,
+            "ps_skew_scores": ps_skew,
+            "tasks_abandoned": abandoned,
+            "tasks_todo": todo,
+            "tasks_doing": doing,
+        }
+        self.engine.evaluate(signals, now)
+        flagged = set(self.engine.active_subjects("straggler"))
+        for role in step_means:
+            is_straggler = role in flagged
+            self._g_straggler.labels(worker=role).set(
+                1 if is_straggler else 0
+            )
+            workers[role]["straggler"] = is_straggler
+        # A worker that stopped reporting (scaled away, dead) must not
+        # pin ANY of its per-worker gauges on /metrics forever — and its
+        # EWMA must not seed a relaunched instance's scoring.
+        for role in self._gauged_workers - set(step_means):
+            self._g_straggler.labels(worker=role).set(0)
+            self._g_straggler_score.labels(worker=role).set(0)
+            for stat in ("mean", "p50", "p99", "ewma"):
+                self._g_step.labels(worker=role, stat=stat).set(0)
+            self._g_mfu.labels(worker=role).set(0)
+            self._ewma.pop(role, None)
+        self._gauged_workers |= set(step_means)
+        self._g_workers.set(len(workers))
+
+        membership_epoch = self.store.latest(
+            "master", "edl_membership_epoch"
+        )
+        summary = {
+            "job": self._job,
+            "ts": round(now, 3),
+            "interval_s": self.interval,
+            "records_per_second": rps,
+            "records_done": records_done,
+            "throughput_history": list(self._throughput_history),
+            "workers": workers,
+            "stragglers": sorted(flagged),
+            "straggler_skew_threshold": self._straggler_skew,
+            "ps": ps,
+            "tasks": {
+                "todo": todo,
+                "doing": doing,
+                "drain_per_second": drain,
+                "eta_seconds": eta,
+                "abandoned": abandoned,
+                "recovered": recovered,
+            },
+            "alerts": self.engine.active(),
+            "alerts_fired": self.engine.fired_total,
+            "membership_epoch": membership_epoch,
+            "roles_scraped": sorted(scraped),
+        }
+        with self._lock:
+            self._summary = summary
+
+    def _family_rate(self, role, name, window_s=RATE_WINDOW_S,
+                     now=None):
+        """Sum of rate() across every labeled series of one family."""
+        total = None
+        for labels in self.store.labelsets(role, name):
+            rate = self.store.rate(
+                role, name, labels, window_s, now=now
+            )
+            if rate is not None:
+                total = (total or 0.0) + rate
+        return total
+
+    def _family_total(self, role, name):
+        total = None
+        for labels in self.store.labelsets(role, name):
+            value = self.store.latest(role, name, labels)
+            if value is not None:
+                total = (total or 0.0) + value
+        return total
+
+    # ---------- consumption ----------
+
+    def summary(self):
+        """JSON-able snapshot for /api/summary and `edl dash`."""
+        with self._lock:
+            return dict(self._summary)
+
+    def stragglers(self):
+        """Worker roles currently flagged (JobStatusResponse field)."""
+        return self.engine.active_subjects("straggler")
+
+    def alerts_fired(self):
+        return self.engine.fired_total

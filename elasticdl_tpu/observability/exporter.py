@@ -2,43 +2,72 @@
 
 One daemonized ThreadingHTTPServer per process serving:
 
-    /metrics   the registry in text-exposition format
-    /healthz   "ok" — a liveness probe target for k8s pod specs
+    /metrics       the registry in text-exposition format
+    /healthz       "ok" — a liveness probe target for k8s pod specs
+    /api/summary   job-level JSON summary (master only — present when a
+                   TelemetryAggregator installed a summary provider)
 
-No third-party dependency: the exposition format is plain text and the
-stdlib HTTP server is enough for a scraper that polls every few seconds.
-Binds 0.0.0.0 (a scrape endpoint is only useful off-host) on the requested
-port; port 0 picks an ephemeral port, published via `.port` and the
-endpoints/ advertisement written by observability.setup().
+GET and HEAD are both answered (k8s http probes default to HEAD; a 501
+there flaps the pod). No third-party dependency: the exposition format is
+plain text and the stdlib HTTP server is enough for a scraper that polls
+every few seconds.
+
+Binds ELASTICDL_METRICS_HOST (default 0.0.0.0 — a scrape endpoint is only
+useful off-host; CI/sandbox runs set 127.0.0.1) on the requested port;
+port 0 picks an ephemeral port, published via `.port` and the endpoints/
+advertisement written by observability.setup().
 """
 
+import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
+METRICS_HOST_ENV = "ELASTICDL_METRICS_HOST"
+
 
 class _Handler(BaseHTTPRequestHandler):
     registry = None
+    exporter = None
 
-    def do_GET(self):
+    def _respond(self, code, body, content_type, send_body=True):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if send_body:
+            self.wfile.write(body)
+
+    def _serve(self, send_body):
         path = self.path.split("?", 1)[0]
         if path == "/metrics":
             body = self.registry.expose().encode()
-            self.send_response(200)
-            self.send_header("Content-Type", CONTENT_TYPE)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._respond(200, body, CONTENT_TYPE, send_body)
         elif path == "/healthz":
-            body = b"ok\n"
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._respond(200, b"ok\n", "text/plain", send_body)
+        elif path == "/api/summary":
+            provider = self.exporter.summary_provider
+            if provider is None:
+                self.send_error(404)
+                return
+            try:
+                body = json.dumps(provider()).encode()
+            except Exception:
+                # A half-updated summary must not kill the probe endpoint.
+                self.send_error(500)
+                return
+            self._respond(200, body, "application/json", send_body)
         else:
             self.send_error(404)
+
+    def do_GET(self):
+        self._serve(send_body=True)
+
+    def do_HEAD(self):
+        # Same status/headers as GET, no body (k8s probes use HEAD).
+        self._serve(send_body=False)
 
     def log_message(self, format, *args):
         # Scrapes every few seconds must not spam the training log.
@@ -46,8 +75,17 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class MetricsExporter:
-    def __init__(self, registry, port=0, host="0.0.0.0"):
-        handler = type("_BoundHandler", (_Handler,), {"registry": registry})
+    def __init__(self, registry, port=0, host=None):
+        if host is None:
+            host = os.environ.get(METRICS_HOST_ENV, "") or "0.0.0.0"
+        # Installed post-construction by the master's TelemetryAggregator;
+        # callable returning a JSON-able dict for /api/summary.
+        self.summary_provider = None
+        handler = type(
+            "_BoundHandler",
+            (_Handler,),
+            {"registry": registry, "exporter": self},
+        )
         self._server = ThreadingHTTPServer((host, port), handler)
         self._server.daemon_threads = True
         self.port = self._server.server_address[1]

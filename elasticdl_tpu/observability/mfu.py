@@ -1,0 +1,217 @@
+"""Per-step MFU (model FLOPs utilization) estimation for workers.
+
+The trainer hands its jitted step function plus the step's example
+arguments to a StepCostModel once per minibatch. The model:
+
+- computes the step's FLOPs once per argument-shape signature via
+  `jitted.lower(*args).compile().cost_analysis()` (an XLA estimate; the
+  AOT lowering is a one-time cost per shape, cached forever after),
+- measures the steady-state step period as the wall time BETWEEN
+  successive observe() calls (which includes pulls/pushes/feed — MFU is
+  utilization of the whole loop, not of the kernel in isolation), and
+- exports `edl_worker_step_flops` and, when a peak-FLOPs figure is
+  known, `edl_worker_mfu` gauges that the master's aggregator re-exports
+  as `edl_job_mfu{worker=...}`.
+
+Everything is guarded: a backend without cost_analysis, an un-lowerable
+step, or an unknown peak simply leaves the gauges absent — never a
+training failure. ELASTICDL_MFU=0 disables the lowering entirely;
+ELASTICDL_PEAK_FLOPS overrides (or provides) the per-device peak.
+"""
+
+import os
+import threading
+import time
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.observability.metrics import default_registry
+
+logger = get_logger("observability.mfu")
+
+MFU_ENV = "ELASTICDL_MFU"
+PEAK_FLOPS_ENV = "ELASTICDL_PEAK_FLOPS"
+
+# Dense peak FLOP/s by device kind (bf16, no sparsity), for the common
+# TPU generations; anything unrecognized needs ELASTICDL_PEAK_FLOPS.
+_DEVICE_PEAK_FLOPS = {
+    "TPU v2": 22.5e12,
+    "TPU v3": 61.25e12,  # per-chip: 2 cores x 30.6 TF/s
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6e": 918e12,
+}
+
+_REG = default_registry()
+_STEP_FLOPS = _REG.gauge(
+    "edl_worker_step_flops",
+    "XLA-estimated FLOPs of one training step (current shape)",
+)
+_MFU = _REG.gauge(
+    "edl_worker_mfu",
+    "Estimated model FLOPs utilization (step flops / period / peak)",
+)
+_STEP_PERIOD = _REG.gauge(
+    "edl_worker_step_period_seconds",
+    "EWMA wall time between successive training steps",
+)
+
+_EWMA_ALPHA = 0.2
+
+
+def enabled():
+    """ELASTICDL_MFU: 1/true forces on, 0/false forces off; the default
+    ("auto") activates only in processes that configured the
+    observability plane (worker/PS/master entrypoints call setup()).
+    Bare trainer construction — unit tests, library embedding — then
+    skips the per-shape AOT lowering entirely."""
+    raw = os.environ.get(MFU_ENV, "auto").lower()
+    if raw in ("0", "false", "no"):
+        return False
+    if raw in ("1", "true", "yes"):
+        return True
+    from elasticdl_tpu import observability
+
+    return observability.current_handle() is not None
+
+
+def peak_flops():
+    """Per-device peak FLOP/s: env override first, then the device-kind
+    table; None when unknown (MFU gauge stays absent then)."""
+    raw = os.environ.get(PEAK_FLOPS_ENV, "")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            logger.warning("Bad %s=%r; ignoring", PEAK_FLOPS_ENV, raw)
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        return None
+    for name, peak in _DEVICE_PEAK_FLOPS.items():
+        if kind.lower().startswith(name.lower()):
+            return peak
+    return None
+
+
+def shape_key(args):
+    """Hashable (shape, dtype) signature of a step's argument pytree."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(args)
+    return tuple(
+        (tuple(getattr(l, "shape", ())), str(getattr(l, "dtype", "")))
+        for l in leaves
+    )
+
+
+def _analyzed_flops(jitted, spec):
+    """FLOPs from XLA's compiled-cost analysis; None when unavailable.
+    `spec` is a ShapeDtypeStruct pytree (AOT lowering needs shapes only —
+    never live buffers, which the real step may have donated by the time
+    the analysis thread runs). cost_analysis() returns a dict (newer jax)
+    or a list of per-module dicts (this image's 0.4.x) — handle both."""
+    analysis = jitted.lower(*spec).compile().cost_analysis()
+    if analysis is None:
+        return None
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    if not analysis:
+        return None
+    flops = analysis.get("flops")
+    if flops is None or flops <= 0:
+        return None
+    return float(flops)
+
+
+_PENDING = object()  # analysis in flight on the background thread
+
+
+class StepCostModel:
+    """Caches per-shape step FLOPs and tracks the step period EWMA."""
+
+    def __init__(self):
+        self._enabled = enabled()
+        self._peak = peak_flops() if self._enabled else None
+        # shape key -> float (analyzed) | None (failed) | _PENDING
+        self._flops = {}
+        self._last_ts = None
+        self._last_key = None
+        self._period_ewma = None
+
+    def observe(self, jitted, args, key_args=None):
+        """Record one about-to-run (or just-dispatched) training step.
+
+        Call once per train_minibatch with the jitted step callable and
+        the exact argument tuple it runs with. `key_args` (default: all
+        of args) is the subtree whose shapes key the cache — trainers
+        pass the (features, labels) batch so the hot path never flattens
+        the full parameter tree; FLOPs for secondary shape variation
+        (e.g. per-batch embedding row counts) reuse the first sighting's
+        estimate. The AOT lowering itself runs on a daemon thread against
+        a ShapeDtypeStruct spec, so the training loop never blocks on the
+        analysis compile."""
+        if not self._enabled or jitted is None:
+            return
+        now = time.perf_counter()
+        try:
+            key = shape_key(args if key_args is None else key_args)
+        except Exception:
+            return
+        if key not in self._flops:
+            self._flops[key] = _PENDING
+            try:
+                import jax
+
+                spec = jax.tree_util.tree_map(
+                    lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype),
+                    args,
+                )
+            except Exception:
+                # Missing cost analysis degrades to absent gauges.
+                self._flops[key] = None
+            else:
+                threading.Thread(
+                    target=self._analyze,
+                    args=(jitted, spec, key),
+                    name="edl-mfu-analysis",
+                    daemon=True,
+                ).start()
+        flops = self._flops[key]
+        if not isinstance(flops, float):
+            flops = None
+        if (
+            self._last_ts is not None
+            and self._last_key == key
+            and now > self._last_ts
+        ):
+            period = now - self._last_ts
+            self._period_ewma = (
+                period
+                if self._period_ewma is None
+                else _EWMA_ALPHA * period
+                + (1 - _EWMA_ALPHA) * self._period_ewma
+            )
+            _STEP_PERIOD.set(self._period_ewma)
+            if flops is not None:
+                _STEP_FLOPS.set(flops)
+                if self._peak:
+                    _MFU.set(
+                        flops / (self._period_ewma * self._peak)
+                    )
+        self._last_ts = now
+        self._last_key = key
+
+    def _analyze(self, jitted, spec, key):
+        try:
+            self._flops[key] = _analyzed_flops(jitted, spec)
+        except Exception:
+            logger.info(
+                "Step cost analysis unavailable; MFU gauges disabled "
+                "for this shape",
+                exc_info=True,
+            )
+            self._flops[key] = None

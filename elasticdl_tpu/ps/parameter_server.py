@@ -74,6 +74,7 @@ class ParameterServer:
             checkpoint_saver=saver,
             checkpoint_steps=checkpoint_steps,
             master_client=master_client,
+            shard_id=ps_id,
         )
         self._server, self.port = rpc.serve(
             self.servicer, rpc.PSERVER_SERVICE, port=port

@@ -36,13 +36,18 @@ DEFAULT_REPORT_VERSION_STEPS = 100
 # Process-global so N in-process shards aggregate (one registry per OS
 # process; real deployments run one shard per process).
 _REG = default_registry()
+# Byte counters carry the shard id so the master's telemetry aggregator
+# can expose per-shard load imbalance even when several in-process shards
+# share one registry (tests) — and so one scrape config covers all shards.
 _PUSH_BYTES = _REG.counter(
-    "edl_ps_push_bytes_total", "Gradient push request bytes received"
+    "edl_ps_push_bytes_total",
+    "Gradient push request bytes received, by shard",
+    labelnames=("shard",),
 )
 _PULL_BYTES = _REG.counter(
     "edl_ps_pull_bytes_total",
     "Parameter/embedding pull response bytes sent",
-    labelnames=("rpc",),
+    labelnames=("rpc", "shard"),
 )
 _PUSHES = _REG.counter(
     "edl_ps_push_total",
@@ -71,9 +76,11 @@ class PserverServicer:
         checkpoint_steps=0,
         master_client=None,
         report_version_steps=DEFAULT_REPORT_VERSION_STEPS,
+        shard_id=0,
     ):
         self._params = parameters
         self._opt = optimizer
+        self._shard = str(shard_id)
         self._use_async = use_async
         self._grads_to_wait = grads_to_wait
         self._sync_version_tolerance = sync_version_tolerance
@@ -139,7 +146,9 @@ class PserverServicer:
                         self._params.dense[name], name
                     )
                 )
-        _PULL_BYTES.labels(rpc="pull_dense_parameters").inc(res.ByteSize())
+        _PULL_BYTES.labels(rpc="pull_dense_parameters", shard=self._shard).inc(
+            res.ByteSize()
+        )
         return res
 
     def pull_embedding_vectors(self, request, context):
@@ -156,7 +165,9 @@ class PserverServicer:
         if request.value_dtype == pb.DT_BFLOAT16:
             values = values.astype(tensor_utils.bfloat16)
         res = tensor_utils.ndarray_to_tensor_pb(values, request.name)
-        _PULL_BYTES.labels(rpc="pull_embedding_vectors").inc(res.ByteSize())
+        _PULL_BYTES.labels(rpc="pull_embedding_vectors", shard=self._shard).inc(
+            res.ByteSize()
+        )
         return res
 
     def pull_embedding_table(self, request, context):
@@ -173,11 +184,13 @@ class PserverServicer:
         res = tensor_utils.ndarray_to_indexed_slices_pb(
             values, ids, request.name
         )
-        _PULL_BYTES.labels(rpc="pull_embedding_table").inc(res.ByteSize())
+        _PULL_BYTES.labels(rpc="pull_embedding_table", shard=self._shard).inc(
+            res.ByteSize()
+        )
         return res
 
     def push_gradients(self, request, context):
-        _PUSH_BYTES.inc(request.ByteSize())
+        _PUSH_BYTES.labels(shard=self._shard).inc(request.ByteSize())
         if self._use_async:
             res = self._push_async(request)
         else:

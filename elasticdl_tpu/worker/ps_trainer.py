@@ -465,14 +465,20 @@ class ParameterServerTrainer(JaxTrainer):
             state = {
                 k: v for k, v in self._variables.items() if k != "params"
             }
+            step_args = (
+                self._variables["params"],
+                state,
+                emb_rows,
+                step_rng,
+                device_features,
+                device_labels,
+            )
+            self.step_cost.observe(
+                self._ps_step, step_args, key_args=step_args[4:]
+            )
             with self.timing.record("train_step"):
                 loss, param_grads, emb_grads, new_state = self._ps_step(
-                    self._variables["params"],
-                    state,
-                    emb_rows,
-                    step_rng,
-                    device_features,
-                    device_labels,
+                    *step_args
                 )
             self._variables.update(new_state)
             accepted, _ = self._push_payload(
@@ -518,14 +524,20 @@ class ParameterServerTrainer(JaxTrainer):
         state = {
             k: v for k, v in self._variables.items() if k != "params"
         }
+        step_args = (
+            self._variables["params"],
+            state,
+            emb_rows,
+            step_rng,
+            device_features,
+            device_labels,
+        )
+        self.step_cost.observe(
+            self._ps_step, step_args, key_args=step_args[4:]
+        )
         with self.timing.record("train_step_dispatch"):
             loss, param_grads, emb_grads, new_state = self._ps_step(
-                self._variables["params"],
-                state,
-                emb_rows,
-                step_rng,
-                device_features,
-                device_labels,
+                *step_args
             )
         self._variables.update(new_state)
         if self._model_steps > 1:

@@ -83,6 +83,11 @@ class JaxTrainer(Trainer):
         from elasticdl_tpu.common.timing import Timing
 
         self.timing = Timing()
+        # Per-step MFU estimate (observability/mfu.py): FLOPs from the
+        # jitted step's cost analysis, period from successive steps.
+        from elasticdl_tpu.observability.mfu import StepCostModel
+
+        self.step_cost = StepCostModel()
 
     # ---------- init ----------
 
@@ -190,12 +195,19 @@ class JaxTrainer(Trainer):
     def train_minibatch(self, features, labels):
         self.init_variables_if_needed(features)
         self._rng, step_rng = jax.random.split(self._rng)
-        self._variables, self._opt_state, loss = self._train_step(
+        step_args = (
             self._variables,
             self._opt_state,
             step_rng,
             _to_device_batch(features),
             _to_device_batch(labels),
+        )
+        # Keyed on the batch only: param shapes are static after init.
+        self.step_cost.observe(
+            self._train_step, step_args, key_args=step_args[3:]
+        )
+        self._variables, self._opt_state, loss = self._train_step(
+            *step_args
         )
         self._version += 1
         # Lazy device scalar: converting to float here would block the host

@@ -42,7 +42,13 @@ import urllib.request
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-SCENARIOS = ("worker-kill", "ps-flap", "rpc-brownout", "master-stall")
+SCENARIOS = (
+    "worker-kill",
+    "ps-flap",
+    "rpc-brownout",
+    "master-stall",
+    "straggler",
+)
 
 
 def _free_port():
@@ -117,6 +123,40 @@ def scenario_env(scenario):
             ],
         }
         return {"ELASTICDL_CHAOS": json.dumps(schedule)}
+    if scenario == "straggler":
+        # No process dies and nothing fails: worker-0's data-plane RPCs
+        # just get slow (role-targeted client-side latency), making it a
+        # straggler the master's telemetry aggregator must FLAG — the
+        # brownout drill proved the job survives faults; this one proves
+        # the framework *tells you who is slow*. A fast aggregation
+        # interval keeps the detection well inside the drill budget.
+        schedule = {
+            "seed": 20260803,
+            "rules": [
+                {
+                    "method": "push_gradients",
+                    "kind": "latency",
+                    "latency_s": 0.25,
+                    "start": 0,
+                    "count": -1,
+                    "side": "client",
+                    "role": "worker-0",
+                },
+                {
+                    "method": "pull_dense_parameters",
+                    "kind": "latency",
+                    "latency_s": 0.1,
+                    "start": 0,
+                    "count": -1,
+                    "side": "client",
+                    "role": "worker-0",
+                },
+            ],
+        }
+        return {
+            "ELASTICDL_CHAOS": json.dumps(schedule),
+            "ELASTICDL_AGGREGATOR_INTERVAL": "1.0",
+        }
     if scenario == "master-stall":
         # Shrink the control-plane deadlines below the stall length so the
         # workers' calls fail fast and RETRY through the stall (instead of
@@ -224,6 +264,11 @@ def run_drill(
 
     if scenario not in SCENARIOS:
         raise ValueError(f"unknown scenario {scenario!r}; one of {SCENARIOS}")
+    if scenario == "straggler" and not obs_dir:
+        raise ValueError(
+            "the straggler scenario needs --obs_dir: detection is read "
+            "from the master's aggregated /metrics and /api/summary"
+        )
     port = _free_port()
     env = dict(os.environ)
     # Full control of the children's import path — do NOT append the
@@ -339,6 +384,10 @@ def run_drill(
             # backend); freezing it stalls the whole control plane while
             # workers and PS keep running.
             chaos_process.stall(train.pid, stall_seconds)
+        elif scenario == "straggler":
+            s = _do_straggler_watch(
+                status, s, port, obs_dir, result, timeout, env
+            )
         # rpc-brownout: nothing to do here — the chaos schedule shipped in
         # the environment is already injecting faults.
 
@@ -392,6 +441,90 @@ def run_drill(
         result["leftover_procs"] = [line for _, line in leftovers]
         for pid, _ in leftovers:
             chaos_process.deliver(pid, signal.SIGKILL)
+
+
+def _master_endpoint(obs_dir):
+    try:
+        with open(
+            os.path.join(obs_dir, "endpoints", "master.json")
+        ) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _do_straggler_watch(status, s, port, obs_dir, result, timeout, env):
+    """Watch the master's aggregated telemetry until it flags the slowed
+    worker: `edl_job_straggler{worker="worker-0"} 1` on the master's own
+    /metrics, the same worker named by /api/summary (with nonzero
+    throughput), and — while the job is still live — one `edl dash
+    --once` frame captured as proof the dashboard renders against a real
+    running job."""
+    deadline = time.time() + timeout
+    result["straggler_flagged"] = None
+    result["summary_throughput"] = None
+    result["summary_stragglers"] = []
+    while time.time() < deadline:
+        info = _master_endpoint(obs_dir)
+        if info is not None:
+            try:
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{info['port']}/metrics", timeout=2
+                ).read().decode()
+                m = re.search(
+                    r'^edl_job_straggler\{worker="([^"]+)"\} 1$',
+                    body,
+                    re.M,
+                )
+                if m:
+                    result["straggler_flagged"] = m.group(1)
+                    summary = json.loads(
+                        urllib.request.urlopen(
+                            f"http://127.0.0.1:{info['port']}/api/summary",
+                            timeout=2,
+                        ).read().decode()
+                    )
+                    result["summary_throughput"] = summary.get(
+                        "records_per_second"
+                    )
+                    result["summary_stragglers"] = summary.get(
+                        "stragglers", []
+                    )
+                    break
+            except (OSError, ValueError):
+                pass  # master mid-setup; poll again
+        s2 = status(time.time() + 5)
+        if s2 is None:
+            break
+        s = s2
+        if s.finished or s.job_failed:
+            break
+        time.sleep(0.5)
+    if result["straggler_flagged"]:
+        # Dashboard snapshot against the LIVE job (the chaos schedule is
+        # stripped: the dash process is an observer, not a test subject).
+        dash_env = {
+            k: v for k, v in env.items() if k != "ELASTICDL_CHAOS"
+        }
+        try:
+            dash = subprocess.run(
+                [
+                    sys.executable, "-m", "elasticdl_tpu.client.main",
+                    "dash", "--master_addr", f"127.0.0.1:{port}",
+                    "--once",
+                ],
+                capture_output=True,
+                text=True,
+                timeout=60,
+                env=dash_env,
+                cwd=REPO,
+            )
+            result["dash_snapshot"] = dash.stdout
+            result["dash_rc"] = dash.returncode
+        except subprocess.TimeoutExpired:
+            result["dash_snapshot"] = ""
+            result["dash_rc"] = -1
+    return s
 
 
 def _do_worker_kill(train, stub, status, s, port, result,
@@ -529,6 +662,12 @@ def main():
                 file=sys.stderr,
             )
         args.num_ps = 0
+    obs_dir = args.obs_dir or None
+    if args.scenario == "straggler" and not obs_dir:
+        import tempfile
+
+        obs_dir = tempfile.mkdtemp(prefix="edl_drill_obs_")
+        print(f"note: --obs_dir defaulted to {obs_dir}", file=sys.stderr)
     result = run_drill(
         args.training_data,
         args.model_zoo,
@@ -538,12 +677,15 @@ def main():
         num_epochs=args.num_epochs,
         strategy=args.strategy,
         scenario=args.scenario,
-        obs_dir=args.obs_dir or None,
+        obs_dir=obs_dir,
         stall_seconds=args.stall_seconds,
     )
     result.pop("log_tail", None)
+    result.pop("dash_snapshot", None)
     print(json.dumps(result))
     ok = result["completed"] and not result["leftover_procs"]
+    if args.scenario == "straggler":
+        ok = ok and bool(result.get("straggler_flagged"))
     if args.expect_records:
         ok = ok and result.get("records_done") == args.expect_records
     return 0 if ok else 1
