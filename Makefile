@@ -9,22 +9,29 @@ proto:
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -x -q
 
-# The ROADMAP tier-1 gate, verbatim: bounded wall clock, collection errors
+# The ROADMAP tier-1 gate, verbatim, behind the static-analysis preamble:
+# a lint failure fails verify before any test runs (the lint plane needs
+# no jax and finishes in seconds). Bounded wall clock, collection errors
 # tolerated, deterministic plugin set, pass-count echoed for the driver.
-verify:
+verify: lint
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Harness self-check: tiny shapes, CPU-safe, < 60 s, per-bench watchdog.
 bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --smoke
 
-# Static invariants (no jax needed): every RPC method has a deadline
-# policy, no call site bypasses the retry/deadline interceptor plane,
-# and the metric namespace stays coherent (edl_ prefix, counter
-# suffixes, no conflicting registrations).
+# The unified static-analysis plane (tools/edl_lint, no jax import,
+# seconds not minutes): concurrency (lock guards + ordering cycles),
+# jit-purity, env-knob registry, proto drift, rpc deadlines, metric
+# names, dead code. docs/STATIC_ANALYSIS.md has the rule catalog and
+# the suppression/baseline workflow. `lint-changed` restricts REPORTING
+# to git-changed files for fast pre-commit runs (analysis always sees
+# the whole program).
 lint:
-	python tools/check_rpc_deadlines.py
-	python tools/check_metric_names.py
+	python -m tools.edl_lint
+
+lint-changed:
+	python -m tools.edl_lint --changed
 
 # The chaos scenario suite (real multi-process jobs with injected faults;
 # docs/ROBUSTNESS.md catalog) under a hard wall-clock cap.
@@ -40,4 +47,4 @@ obs:
 native:
 	@if [ -f elasticdl_tpu/native/Makefile ]; then $(MAKE) -C elasticdl_tpu/native; else echo "native kernels not present yet"; fi
 
-.PHONY: proto test verify bench-smoke lint chaos obs native
+.PHONY: proto test verify bench-smoke lint lint-changed chaos obs native

@@ -31,6 +31,7 @@ import time
 
 import grpc
 
+from elasticdl_tpu.common import knobs
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.observability.metrics import default_registry
 
@@ -71,7 +72,7 @@ class FaultRule:
     def matches_role(self):
         if not self.role:
             return True
-        stamp = os.environ.get("ELASTICDL_ROLE", "")
+        stamp = knobs.get_str("ELASTICDL_ROLE")
         if self.role.endswith("*"):
             return stamp.startswith(self.role[:-1])
         return stamp == self.role
@@ -140,7 +141,7 @@ def schedule_from_env():
     set of rule counters), mirroring how one process experiences one
     network."""
     global _env_schedule
-    raw = os.environ.get(CHAOS_ENV, "")
+    raw = knobs.raw(CHAOS_ENV)
     if not raw:
         return None
     with _env_lock:
@@ -158,13 +159,6 @@ def schedule_from_env():
                 os.environ.pop(CHAOS_ENV, None)
                 return None
         return _env_schedule
-
-
-def reset_env_schedule():
-    """Drop the cached env schedule (tests that flip ELASTICDL_CHAOS)."""
-    global _env_schedule
-    with _env_lock:
-        _env_schedule = None
 
 
 class ChaosServerInterceptor(grpc.ServerInterceptor):

@@ -40,14 +40,6 @@ def accuracy_metric():
     )
 
 
-def mse_metric():
-    return MeanMetric(
-        lambda outputs, labels: np.mean(
-            (np.asarray(outputs) - np.asarray(labels)) ** 2, axis=-1
-        )
-    )
-
-
 class AUCMetric:
     """Streaming ROC AUC via fixed-threshold confusion buckets (the same
     approach as Keras' AUC metric, 200 thresholds)."""

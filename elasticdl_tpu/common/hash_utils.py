@@ -16,10 +16,6 @@ def string_to_id(name: str, num_buckets: int) -> int:
     return int(h, 16) % num_buckets
 
 
-def int_to_id(value: int, num_buckets: int) -> int:
-    return int(value) % num_buckets
-
-
 def scatter_embedding_ids(ids: np.ndarray, num_ps: int):
     """Partition embedding ids by modulo; returns {ps_id: (ids, positions)}.
 

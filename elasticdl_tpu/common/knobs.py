@@ -1,0 +1,232 @@
+"""Central registry of every ELASTICDL_* environment knob.
+
+Every environment variable the framework reads is declared HERE, once,
+with its type, default, and documentation. Call sites then fetch values
+through the typed accessors (`get_str` / `get_int` / `get_float`) or the
+raw string (`raw`, `is_set`) — never through `os.environ` directly. The
+`env-knobs` rule of `python -m tools.edl_lint` enforces both halves
+statically: an `os.environ` read of an `ELASTICDL_*` key outside this
+module is an error, and so is an accessor call naming an undeclared knob.
+
+Reads are LIVE (`os.environ` is consulted on every call, no caching):
+tests and in-process drills mutate the environment and expect
+`rpc.reload_config()`-style re-reads to see the change. Modules that
+want read-once semantics cache at their own layer, exactly as before.
+
+docs/KNOBS.md is generated from this registry
+(`python -m tools.edl_lint --write-knob-docs`); the env-knobs rule fails
+when the checked-in table drifts from the declarations below.
+
+Stdlib-only, imports nothing from the package (log_utils reads its own
+level/format knobs through here, so this module must sit below it).
+"""
+
+import logging
+import os
+
+_logger = logging.getLogger("elasticdl_tpu.common.knobs")
+
+_TYPES = ("str", "int", "float")
+
+
+class Knob:
+    """One declared environment knob: name, type, default, doc."""
+
+    __slots__ = ("name", "type", "default", "doc")
+
+    def __init__(self, name, type, default, doc):
+        self.name = name
+        self.type = type
+        self.default = default
+        self.doc = doc
+
+
+_REGISTRY = {}
+
+
+def declare(name, type, default, doc):
+    """Register a knob. Re-declaring with a conflicting type or default
+    is an error (two modules silently disagreeing on a default is exactly
+    the bug the registry exists to prevent)."""
+    if type not in _TYPES:
+        raise ValueError(f"knob {name}: unknown type {type!r}")
+    if not name.startswith("ELASTICDL_"):
+        raise ValueError(f"knob {name}: names must start with ELASTICDL_")
+    prior = _REGISTRY.get(name)
+    if prior is not None:
+        if (prior.type, prior.default) != (type, default):
+            raise ValueError(
+                f"knob {name} re-declared as ({type}, {default!r}); "
+                f"conflicts with ({prior.type}, {prior.default!r})"
+            )
+        return prior
+    knob = Knob(name, type, default, doc)
+    _REGISTRY[name] = knob
+    return knob
+
+
+def _knob(name):
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"environment knob {name!r} is not declared in "
+            f"elasticdl_tpu/common/knobs.py"
+        ) from None
+
+
+def raw(name):
+    """The raw environment string for a DECLARED knob ("" when unset).
+    For callers that need presence/emptiness semantics (JSON blobs,
+    forward-to-child-env logic) rather than a parsed value."""
+    _knob(name)
+    return os.environ.get(name, "")
+
+
+def is_set(name):
+    """True when the declared knob is present and non-empty."""
+    return bool(raw(name))
+
+
+def get_str(name):
+    knob = _knob(name)
+    value = os.environ.get(name, "")
+    return value if value else knob.default
+
+
+def get_int(name):
+    knob = _knob(name)
+    value = os.environ.get(name, "")
+    if value:
+        try:
+            return int(value)
+        except ValueError:
+            # Float-formatted values ("12.0") truncate, matching the
+            # int(float(...)) parsing the pre-registry helpers used.
+            try:
+                return int(float(value))
+            except ValueError:
+                _logger.warning("Bad %s=%r; using default %r", name,
+                                value, knob.default)
+    return knob.default
+
+
+def get_float(name):
+    knob = _knob(name)
+    value = os.environ.get(name, "")
+    if value:
+        try:
+            return float(value)
+        except ValueError:
+            _logger.warning("Bad %s=%r; using default %r", name, value,
+                            knob.default)
+    return knob.default
+
+
+def all_knobs():
+    """Every declared knob, name-sorted (docs generation, lint)."""
+    return [_REGISTRY[name] for name in sorted(_REGISTRY)]
+
+
+def docs_table():
+    """The markdown table docs/KNOBS.md carries (generated, lint-pinned)."""
+    lines = [
+        "| Knob | Type | Default | Purpose |",
+        "| --- | --- | --- | --- |",
+    ]
+    for knob in all_knobs():
+        default = "" if knob.default in ("", None) else repr(knob.default)
+        doc = " ".join(knob.doc.split())
+        lines.append(
+            f"| `{knob.name}` | {knob.type} | `{default}` | {doc} |"
+            if default
+            else f"| `{knob.name}` | {knob.type} | *(unset)* | {doc} |"
+        )
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# The registry. One declaration per knob, grouped by subsystem. Defaults
+# mirror the behavior each subsystem shipped with; the accessor returns
+# the default when the variable is unset, empty, or unparseable.
+# ---------------------------------------------------------------------------
+
+# -- identity / logging (common/log_utils.py, chaos/injection.py) --
+declare("ELASTICDL_JOB_NAME", "str", "",
+        "Job name stamped into JSON log records and event logs; set by "
+        "the master for every spawned instance.")
+declare("ELASTICDL_ROLE", "str", "",
+        "This process's role stamp (master / worker-N / ps-N); set by the "
+        "instance managers, read by logging and role-targeted chaos.")
+declare("ELASTICDL_LOG_LEVEL", "str", "",
+        "Package log level: DEBUG/INFO/WARNING/ERROR or a number; "
+        "default INFO.")
+declare("ELASTICDL_LOG_FORMAT", "str", "",
+        "\"json\" switches to one JSON object per log line with job/pod "
+        "identity; anything else keeps the human format.")
+
+# -- observability plane (observability/) --
+declare("ELASTICDL_OBS_DIR", "str", "",
+        "Directory for traces, the event log, and endpoint "
+        "advertisements; the master seeds it into every child process.")
+declare("ELASTICDL_METRICS_PORT", "int", 0,
+        "Port for the /metrics exporter; 0 binds an ephemeral port, "
+        "negative disables the endpoint.")
+declare("ELASTICDL_METRICS_HOST", "str", "",
+        "Bind address for the /metrics exporter (default 0.0.0.0); also "
+        "the advertised scrape host when it names a real interface.")
+declare("ELASTICDL_AGGREGATOR_INTERVAL", "float", 2.0,
+        "Master telemetry aggregator scrape period in seconds.")
+declare("ELASTICDL_MFU", "str", "auto",
+        "MFU instrumentation: 1/true forces on, 0/false forces off, "
+        "\"auto\" activates only where observability.setup() ran.")
+declare("ELASTICDL_PEAK_FLOPS", "float", 0.0,
+        "Per-device peak FLOP/s override for MFU; 0 falls back to the "
+        "device-kind table.")
+
+# -- alert rules (observability/alerts.py) --
+declare("ELASTICDL_ALERT_STRAGGLER_SKEW", "float", 2.0,
+        "Straggler alert threshold: worker EWMA step latency over fleet "
+        "median.")
+declare("ELASTICDL_ALERT_PS_SKEW", "float", 3.0,
+        "PS load alert threshold: hottest shard byte rate over the mean "
+        "byte rate.")
+declare("ELASTICDL_ALERT_STALL_SECONDS", "float", 60.0,
+        "Stall alert: records_done frozen this long with tasks in "
+        "flight.")
+declare("ELASTICDL_ALERT_ABANDONED", "float", 1.0,
+        "Abandoned-task count threshold for the abandonment alert.")
+
+# -- rpc plane (common/rpc.py) --
+declare("ELASTICDL_RPC_DEADLINES", "str", "",
+        "JSON {method: seconds} per-method deadline overrides.")
+declare("ELASTICDL_RPC_MAX_ATTEMPTS", "int", 0,
+        "Override max retry attempts for all methods; 0/unset keeps the "
+        "per-method matrix.")
+declare("ELASTICDL_RPC_BACKOFF_BASE", "float", 0.0,
+        "Override retry backoff base seconds for all methods; 0/unset "
+        "keeps the matrix.")
+declare("ELASTICDL_RPC_BACKOFF_MAX", "float", 0.0,
+        "Override retry backoff cap seconds for all methods; 0/unset "
+        "keeps the matrix.")
+declare("ELASTICDL_RPC_BREAKER_THRESHOLD", "int", 8,
+        "Consecutive connectivity failures that trip a peer's circuit "
+        "breaker; <=0 disables the breaker.")
+declare("ELASTICDL_RPC_BREAKER_COOLDOWN", "float", 5.0,
+        "Seconds an open breaker waits before a half-open probe.")
+declare("ELASTICDL_RPC_READY_TIMEOUT", "float", 30.0,
+        "Channel-readiness TCP probe budget in seconds; 0 disables the "
+        "ready-wait.")
+
+# -- worker resilience (worker/) --
+declare("ELASTICDL_PS_DEGRADED_BLOCK_SECONDS", "float", 20.0,
+        "Budget for _sync_model's re-seed/backoff loop on a degraded PS "
+        "shard before failing the minibatch up the retry ladder.")
+declare("ELASTICDL_MASTER_PATIENCE_SECONDS", "float", 120.0,
+        "How long the worker task loop rides out an unreachable master "
+        "before letting the failure propagate.")
+
+# -- chaos (chaos/injection.py) --
+declare("ELASTICDL_CHAOS", "str", "",
+        "JSON fault schedule injected into the rpc plane; set by drills, "
+        "absent in production.")

@@ -17,8 +17,9 @@ ELASTICDL_ROLE environment variables the master sets for spawned instances.
 
 import json
 import logging
-import os
 import sys
+
+from elasticdl_tpu.common import knobs
 
 _FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
 _configured = False
@@ -44,8 +45,8 @@ class JsonFormatter(logging.Formatter):
         }
         out.update(_identity)
         if not _identity:
-            job = os.environ.get("ELASTICDL_JOB_NAME", "")
-            role = os.environ.get("ELASTICDL_ROLE", "")
+            job = knobs.get_str("ELASTICDL_JOB_NAME")
+            role = knobs.get_str("ELASTICDL_ROLE")
             if job:
                 out["job"] = job
             if role:
@@ -56,7 +57,7 @@ class JsonFormatter(logging.Formatter):
 
 
 def _resolve_level():
-    raw = os.environ.get("ELASTICDL_LOG_LEVEL", "").strip()
+    raw = knobs.get_str("ELASTICDL_LOG_LEVEL").strip()
     if not raw:
         return logging.INFO
     if raw.isdigit():
@@ -73,7 +74,7 @@ def configure(force=False):
     for handler in list(root.handlers):
         root.removeHandler(handler)
     handler = logging.StreamHandler(sys.stderr)
-    if os.environ.get("ELASTICDL_LOG_FORMAT", "").lower() == "json":
+    if knobs.get_str("ELASTICDL_LOG_FORMAT").lower() == "json":
         handler.setFormatter(JsonFormatter())
     else:
         handler.setFormatter(logging.Formatter(_FORMAT))

@@ -35,7 +35,6 @@ edl_rpc_breaker_trips_total, edl_rpc_breaker_fast_fail_total.
 import concurrent.futures
 import dataclasses
 import json
-import os
 import random
 import socket
 import threading
@@ -43,6 +42,7 @@ import time
 
 import grpc
 
+from elasticdl_tpu.common import knobs
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.observability import tracing
 from elasticdl_tpu.observability.metrics import default_registry
@@ -225,7 +225,7 @@ _policy_cache = None
 def _load_policies():
     policies = dict(METHOD_POLICIES)
     overrides = {}
-    raw = os.environ.get("ELASTICDL_RPC_DEADLINES", "")
+    raw = knobs.raw("ELASTICDL_RPC_DEADLINES")
     if raw:
         try:
             overrides = {
@@ -239,7 +239,7 @@ def _load_policies():
         ("ELASTICDL_RPC_BACKOFF_BASE", "backoff_base", float),
         ("ELASTICDL_RPC_BACKOFF_MAX", "backoff_max", float),
     ):
-        raw = os.environ.get(env, "")
+        raw = knobs.raw(env)
         if raw:
             try:
                 changes[field] = cast(raw)
@@ -273,8 +273,8 @@ def reload_config():
     global _policy_cache
     with _config_lock:
         _policy_cache = None
-    threshold = int(_env_float("ELASTICDL_RPC_BREAKER_THRESHOLD", 8))
-    cooldown = _env_float("ELASTICDL_RPC_BREAKER_COOLDOWN", 5.0)
+    threshold = knobs.get_int("ELASTICDL_RPC_BREAKER_THRESHOLD")
+    cooldown = knobs.get_float("ELASTICDL_RPC_BREAKER_COOLDOWN")
     with _breakers_lock:
         for breaker in _breakers.values():
             with breaker._lock:
@@ -285,22 +285,16 @@ def reload_config():
                 breaker._probing = False
 
 
-def _env_float(name, default):
-    raw = os.environ.get(name, "")
-    try:
-        return float(raw) if raw else default
-    except ValueError:
-        return default
-
-
-DEFAULT_READY_TIMEOUT = 30.0
-
-
 def ready_timeout():
     """The channel-readiness probe budget (seconds) this process uses —
     the single accessor for ELASTICDL_RPC_READY_TIMEOUT, shared by
     build_channel and clients that probe on their own (PSClient)."""
-    return _env_float("ELASTICDL_RPC_READY_TIMEOUT", DEFAULT_READY_TIMEOUT)
+    return knobs.get_float("ELASTICDL_RPC_READY_TIMEOUT")
+
+
+# build_channel's `ready_timeout` PARAMETER shadows the accessor above;
+# this alias keeps the accessor the single reader of the knob there.
+_default_ready_timeout = ready_timeout
 
 
 # ---------- synthetic call objects ----------
@@ -395,12 +389,12 @@ class CircuitBreaker:
         self.threshold = (
             threshold
             if threshold is not None
-            else int(_env_float("ELASTICDL_RPC_BREAKER_THRESHOLD", 8))
+            else knobs.get_int("ELASTICDL_RPC_BREAKER_THRESHOLD")
         )
         self.cooldown = (
             cooldown
             if cooldown is not None
-            else _env_float("ELASTICDL_RPC_BREAKER_COOLDOWN", 5.0)
+            else knobs.get_float("ELASTICDL_RPC_BREAKER_COOLDOWN")
         )
         self._lock = threading.Lock()
         self._state = self.CLOSED
@@ -800,9 +794,7 @@ def build_channel(addr: str, ready_timeout=None, chaos=None) -> grpc.Channel:
     if ready_timeout is None:
         # (the module-level ready_timeout() accessor; the parameter
         # shadows its name here)
-        ready_timeout = _env_float(
-            "ELASTICDL_RPC_READY_TIMEOUT", DEFAULT_READY_TIMEOUT
-        )
+        ready_timeout = _default_ready_timeout()
     if ready_timeout > 0:
         if not wait_channel_ready(addr, ready_timeout):
             logger.warning(

@@ -14,7 +14,6 @@ rises above 0.5 within a few hundred steps.
 import numpy as np
 
 from elasticdl_tpu.data.example import encode_example
-from elasticdl_tpu.data.recordfile import RecordFileWriter
 from elasticdl_tpu.models.dac_ctr import feature_config as fc
 
 
@@ -90,10 +89,3 @@ def iter_criteo_records(num_examples, seed=0, chunk=4096):
             yield encode_example(features)
         remaining -= n
         part += 1
-
-
-def write_criteo_recordfile(path, num_examples, seed=0):
-    with RecordFileWriter(path) as w:
-        for record in iter_criteo_records(num_examples, seed=seed):
-            w.write(record)
-    return path

@@ -7,12 +7,8 @@ learnable synthetic data (class-dependent template + noise) with the same
 shapes, so end-to-end training demonstrably reduces loss.
 """
 
-import os
-
 import numpy as np
 
-from elasticdl_tpu.data.example import encode_example
-from elasticdl_tpu.data.recordfile import RecordFileWriter
 
 
 def synthetic_classification_arrays(
@@ -53,47 +49,3 @@ def synthetic_lm_tokens(
         choice = rng.integers(0, branching, num_sequences)
         state = successors[state, choice]
     return seqs
-
-
-def write_synthetic_lm(
-    output_dir,
-    num_sequences=256,
-    seq_len=128,
-    vocab=256,
-    num_shards=2,
-    seed=0,
-):
-    """`num_shards` .edlr files of {"tokens": [seq_len+1]} examples."""
-    os.makedirs(output_dir, exist_ok=True)
-    seqs = synthetic_lm_tokens(num_sequences, seq_len, vocab, seed=seed)
-    per_shard = (num_sequences + num_shards - 1) // num_shards
-    for s in range(num_shards):
-        lo, hi = s * per_shard, min((s + 1) * per_shard, num_sequences)
-        path = os.path.join(output_dir, f"lm-shard-{s}.edlr")
-        with RecordFileWriter(path) as w:
-            for i in range(lo, hi):
-                w.write(encode_example({"tokens": seqs[i]}))
-    return output_dir
-
-
-def write_synthetic_mnist(
-    output_dir, num_examples=512, num_shards=2, seed=0, **kwargs
-):
-    """Create `num_shards` .edlr files of synthetic 28x28 examples; returns
-    the directory."""
-    os.makedirs(output_dir, exist_ok=True)
-    images, labels = synthetic_classification_arrays(
-        num_examples, seed=seed, **kwargs
-    )
-    per_shard = (num_examples + num_shards - 1) // num_shards
-    for s in range(num_shards):
-        lo, hi = s * per_shard, min((s + 1) * per_shard, num_examples)
-        path = os.path.join(output_dir, f"shard-{s}.edlr")
-        with RecordFileWriter(path) as w:
-            for i in range(lo, hi):
-                w.write(
-                    encode_example(
-                        {"image": images[i], "label": labels[i]}
-                    )
-                )
-    return output_dir

@@ -15,7 +15,7 @@ import sys
 import time
 
 from elasticdl_tpu import observability
-from elasticdl_tpu.common import rpc
+from elasticdl_tpu.common import knobs, rpc
 from elasticdl_tpu.common.args import build_arguments_from_parsed_result
 from elasticdl_tpu.common.constants import DistributionStrategy
 from elasticdl_tpu.common.log_utils import get_logger
@@ -78,8 +78,8 @@ class Master:
         # launches, and every later lifecycle transition land in the event
         # log/registry. Spawned worker/PS processes find the same obs dir
         # (and the job identity) through the environment.
-        obs_dir = getattr(args, "metrics_dir", "") or os.environ.get(
-            observability.OBS_DIR_ENV, ""
+        obs_dir = getattr(args, "metrics_dir", "") or knobs.get_str(
+            observability.OBS_DIR_ENV
         )
         if obs_dir:
             os.environ[observability.OBS_DIR_ENV] = obs_dir
@@ -225,15 +225,15 @@ class Master:
             )
 
             envs = {observability.JOB_NAME_ENV: args.job_name}
-            if os.environ.get(observability.OBS_DIR_ENV):
-                envs[observability.OBS_DIR_ENV] = os.environ[
+            if knobs.is_set(observability.OBS_DIR_ENV):
+                envs[observability.OBS_DIR_ENV] = knobs.raw(
                     observability.OBS_DIR_ENV
-                ]
+                )
             # Log identity/format follows the master into the pods so a
             # chaos run's JSON logs correlate across roles.
             for var in ("ELASTICDL_LOG_LEVEL", "ELASTICDL_LOG_FORMAT"):
-                if os.environ.get(var):
-                    envs[var] = os.environ[var]
+                if knobs.is_set(var):
+                    envs[var] = knobs.raw(var)
             return K8sInstanceManager(
                 args.namespace,
                 args.job_name,

@@ -25,6 +25,7 @@ environment variables (set by the master before it launches instances).
 import json
 import os
 
+from elasticdl_tpu.common import knobs
 from elasticdl_tpu.observability import events as _events
 from elasticdl_tpu.observability import tracing as _tracing
 from elasticdl_tpu.observability.metrics import default_registry  # noqa: F401
@@ -92,11 +93,11 @@ def setup(role, job="", obs_dir=None, metrics_port=None, registry=None):
     from elasticdl_tpu.observability.metrics import default_registry
 
     if obs_dir is None:
-        obs_dir = os.environ.get(OBS_DIR_ENV, "")
+        obs_dir = knobs.get_str(OBS_DIR_ENV)
     if not job:
-        job = os.environ.get(JOB_NAME_ENV, "")
+        job = knobs.get_str(JOB_NAME_ENV)
     if metrics_port is None:
-        metrics_port = int(os.environ.get(METRICS_PORT_ENV, "0"))
+        metrics_port = knobs.get_int(METRICS_PORT_ENV)
     log_utils.set_identity(job=job, role=role)
 
     recorder = None
@@ -136,7 +137,7 @@ def setup(role, job="", obs_dir=None, metrics_port=None, registry=None):
 
 
 def _scrape_host():
-    bind = os.environ.get("ELASTICDL_METRICS_HOST", "")
+    bind = knobs.get_str("ELASTICDL_METRICS_HOST")
     if bind and bind not in ("0.0.0.0", "::"):
         return bind
     return os.environ.get("MY_POD_IP", "127.0.0.1")

@@ -32,6 +32,7 @@ import threading
 import time
 import urllib.request
 
+from elasticdl_tpu.common import knobs
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.observability import alerts as alerts_mod
 from elasticdl_tpu.observability import promtext
@@ -40,7 +41,6 @@ from elasticdl_tpu.observability.metrics import default_registry
 logger = get_logger("observability.aggregator")
 
 INTERVAL_ENV = "ELASTICDL_AGGREGATOR_INTERVAL"
-DEFAULT_INTERVAL = 2.0
 
 # Ring depth per series: at the default 2 s interval this is ~8.5 min of
 # history — enough for rate windows and dashboard sparklines, bounded
@@ -196,12 +196,7 @@ class TelemetryAggregator:
         self._registry = registry or default_registry()
         self._job = job
         if interval is None:
-            try:
-                interval = float(
-                    os.environ.get(INTERVAL_ENV, "") or DEFAULT_INTERVAL
-                )
-            except ValueError:
-                interval = DEFAULT_INTERVAL
+            interval = knobs.get_float(INTERVAL_ENV)
         self.interval = max(0.2, interval)
         self._scrape_timeout = scrape_timeout
         self.store = SeriesStore()

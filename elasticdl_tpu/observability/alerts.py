@@ -33,10 +33,10 @@ Tuning (all optional):
   ELASTICDL_ALERT_ABANDONED       abandoned-task count threshold (def 1)
 """
 
-import os
 import threading
 import time
 
+from elasticdl_tpu.common import knobs
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.observability import emit_event
 from elasticdl_tpu.observability.metrics import default_registry
@@ -47,19 +47,6 @@ STRAGGLER_SKEW_ENV = "ELASTICDL_ALERT_STRAGGLER_SKEW"
 PS_SKEW_ENV = "ELASTICDL_ALERT_PS_SKEW"
 STALL_SECONDS_ENV = "ELASTICDL_ALERT_STALL_SECONDS"
 ABANDONED_ENV = "ELASTICDL_ALERT_ABANDONED"
-
-DEFAULT_STRAGGLER_SKEW = 2.0
-DEFAULT_PS_SKEW = 3.0
-DEFAULT_STALL_SECONDS = 60.0
-DEFAULT_ABANDONED = 1
-
-
-def _env_float(name, default):
-    try:
-        return float(os.environ.get(name, ""))
-    except ValueError:
-        return default
-
 
 class Rule:
     """One named condition; evaluate() returns {subject: detail_dict} for
@@ -145,7 +132,7 @@ class StallRule(Rule):
 
 
 def straggler_skew_threshold():
-    return _env_float(STRAGGLER_SKEW_ENV, DEFAULT_STRAGGLER_SKEW)
+    return knobs.get_float(STRAGGLER_SKEW_ENV)
 
 
 def default_rules():
@@ -157,18 +144,18 @@ def default_rules():
         SkewRule(
             "ps_imbalance",
             "ps_skew_scores",
-            _env_float(PS_SKEW_ENV, DEFAULT_PS_SKEW),
+            knobs.get_float(PS_SKEW_ENV),
         ),
         ThresholdRule(
             "tasks_abandoned",
             "tasks_abandoned",
-            _env_float(ABANDONED_ENV, DEFAULT_ABANDONED),
+            knobs.get_float(ABANDONED_ENV),
         ),
         StallRule(
             "throughput_stall",
             progress="records_done",
             gate="tasks_doing",
-            seconds=_env_float(STALL_SECONDS_ENV, DEFAULT_STALL_SECONDS),
+            seconds=knobs.get_float(STALL_SECONDS_ENV),
         ),
     ]
 

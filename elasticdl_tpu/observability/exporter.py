@@ -19,9 +19,10 @@ advertisement written by observability.setup().
 """
 
 import json
-import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from elasticdl_tpu.common import knobs
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -77,7 +78,7 @@ class _Handler(BaseHTTPRequestHandler):
 class MetricsExporter:
     def __init__(self, registry, port=0, host=None):
         if host is None:
-            host = os.environ.get(METRICS_HOST_ENV, "") or "0.0.0.0"
+            host = knobs.get_str(METRICS_HOST_ENV) or "0.0.0.0"
         # Installed post-construction by the master's TelemetryAggregator;
         # callable returning a JSON-able dict for /api/summary.
         self.summary_provider = None

@@ -19,10 +19,10 @@ training failure. ELASTICDL_MFU=0 disables the lowering entirely;
 ELASTICDL_PEAK_FLOPS overrides (or provides) the per-device peak.
 """
 
-import os
 import threading
 import time
 
+from elasticdl_tpu.common import knobs
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.observability.metrics import default_registry
 
@@ -66,7 +66,7 @@ def enabled():
     observability plane (worker/PS/master entrypoints call setup()).
     Bare trainer construction — unit tests, library embedding — then
     skips the per-shape AOT lowering entirely."""
-    raw = os.environ.get(MFU_ENV, "auto").lower()
+    raw = knobs.get_str(MFU_ENV).lower()
     if raw in ("0", "false", "no"):
         return False
     if raw in ("1", "true", "yes"):
@@ -79,12 +79,9 @@ def enabled():
 def peak_flops():
     """Per-device peak FLOP/s: env override first, then the device-kind
     table; None when unknown (MFU gauge stays absent then)."""
-    raw = os.environ.get(PEAK_FLOPS_ENV, "")
-    if raw:
-        try:
-            return float(raw)
-        except ValueError:
-            logger.warning("Bad %s=%r; ignoring", PEAK_FLOPS_ENV, raw)
+    override = knobs.get_float(PEAK_FLOPS_ENV)
+    if override:
+        return override
     try:
         import jax
 

@@ -62,10 +62,6 @@ class TraceContext:
         return out
 
 
-def current_context():
-    return _context.get()
-
-
 def set_context(task_id=None, lease_epoch=None, job=None, trace_id=None):
     """Create/refresh this thread's trace context; returns it. Starting a
     new task (task_id given, different from the current one) mints a new

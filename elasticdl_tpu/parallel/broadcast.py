@@ -8,7 +8,6 @@ the wire as position-indexed leaves — every worker runs the same model code,
 so treedefs agree and the receiver unflattens with its own local treedef.
 """
 
-import threading
 
 import jax
 import numpy as np

@@ -30,7 +30,6 @@ collective here names only the stage axis.
 """
 
 import collections
-import functools
 
 import jax
 import jax.numpy as jnp

@@ -124,7 +124,7 @@ class EmbeddingTable:
                 count=len(self._id_to_row),
             )
 
-    def _grow(self, min_capacity):
+    def _grow_locked(self, min_capacity):
         capacity = self._slab.shape[0]
         while capacity < min_capacity:
             capacity *= 2
@@ -142,7 +142,7 @@ class EmbeddingTable:
         # unseen ids stays reproducible.
         return (self._seed * 0x9E3779B1 + row + 1) & 0xFFFFFFFFFFFFFFFF
 
-    def _init_rows(self, start, n):
+    def _init_rows_locked(self, start, n):
         """Initialize the fresh contiguous rows [start, start+n). Called
         under the lock, after any grow."""
         if n <= 0:
@@ -190,8 +190,8 @@ class EmbeddingTable:
                 n_new = size_after - size_before
                 if n_new:
                     if size_after > self._slab.shape[0]:
-                        self._grow(size_after)
-                    self._init_rows(size_before, n_new)
+                        self._grow_locked(size_after)
+                    self._init_rows_locked(size_before, n_new)
                 return rows
             rows = np.empty(len(ids), dtype=np.int64)
             for i, id_ in enumerate(ids):
@@ -202,7 +202,7 @@ class EmbeddingTable:
                         continue
                     row = len(self._id_to_row)
                     if row >= self._slab.shape[0]:
-                        self._grow(row + 1)
+                        self._grow_locked(row + 1)
                     self._id_to_row[int(id_)] = row
                     self._init_row(row)
                 rows[i] = row

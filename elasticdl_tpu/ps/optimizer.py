@@ -88,8 +88,12 @@ class PSOptimizer:
 
     def end_apply(self):
         """Close the push opened by begin_apply; standalone apply_* calls
-        (unit tests) return to bump-per-call stepping."""
-        self._apply_step = None
+        (unit tests) return to bump-per-call stepping. Takes the step
+        lock like begin_apply: without it, a concurrent push's shared
+        step can be cleared mid-apply, silently degrading that push to
+        bump-per-call stepping."""
+        with self._step_lock:
+            self._apply_step = None
 
     def _cur_step(self):
         if self._apply_step is not None:

@@ -8,7 +8,6 @@ id/count), serve, and exit when the master goes away.
 """
 
 import threading
-import time
 
 from elasticdl_tpu.common import rpc
 from elasticdl_tpu.common.log_utils import get_logger

@@ -1148,12 +1148,18 @@ class AllReduceTrainer(JaxTrainer):
         if self._mesh is None:
             self.init_world_if_needed(force=True)
         elif first_init:
-            self._variables = jax.device_put(
-                self._variables, self._variables_sharding(self._variables)
-            )
-            self._opt_state = jax.device_put(
-                self._opt_state, self._opt_placement(self._opt_state)
-            )
+            # The broadcast server's _state_provider reads (variables,
+            # opt_state) as a pair from gRPC threads; replacing them one
+            # by one outside the lock can serve a regrouping peer fresh
+            # variables paired with stale optimizer moments.
+            with self._state_lock:
+                self._variables = jax.device_put(
+                    self._variables,
+                    self._variables_sharding(self._variables),
+                )
+                self._opt_state = jax.device_put(
+                    self._opt_state, self._opt_placement(self._opt_state)
+                )
 
     def train_minibatch(self, features, labels):
         self.init_variables_if_needed(features)

@@ -20,13 +20,13 @@ reference's train_with_local_model) — the next successful pull overwrites
 that local drift, so the PS remains the source of truth.
 """
 
-import os
 
 import grpc
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from elasticdl_tpu.common import knobs
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.pytree_utils import (
     flatten_params,
@@ -115,8 +115,8 @@ class ParameterServerTrainer(JaxTrainer):
         # take up to its own rpc retry budget (deadline x attempts) on a
         # TCP-accepting-but-wedged peer, so the worst case is this budget
         # plus one pull's budget.
-        self._degraded_block_seconds = float(
-            os.environ.get("ELASTICDL_PS_DEGRADED_BLOCK_SECONDS", "20")
+        self._degraded_block_seconds = knobs.get_float(
+            "ELASTICDL_PS_DEGRADED_BLOCK_SECONDS"
         )
         self._param_names = None
         self._embedding_dims = {}  # table -> dim, derived at init

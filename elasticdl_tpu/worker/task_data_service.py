@@ -9,11 +9,11 @@ reference needed a pending-task deque for becomes trivial, and a recovered
 task re-runs whole.
 """
 
-import os
 import time
 
 import grpc
 
+from elasticdl_tpu.common import knobs
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
@@ -23,8 +23,8 @@ _WAIT_SLEEP_SECONDS = 0.5
 # How long the task loop tolerates an unreachable master (restart, stall)
 # before letting the failure propagate and the worker exit. Each failed
 # poll already burned the rpc plane's per-call retry budget.
-_MASTER_PATIENCE_SECONDS = float(
-    os.environ.get("ELASTICDL_MASTER_PATIENCE_SECONDS", "120")
+_MASTER_PATIENCE_SECONDS = knobs.get_float(
+    "ELASTICDL_MASTER_PATIENCE_SECONDS"
 )
 
 # Only CONNECTIVITY failures are worth riding out: a stalled or

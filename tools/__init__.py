@@ -1,0 +1,2 @@
+# Makes `python -m tools.edl_lint` work; the scripts in this directory
+# remain directly runnable and do not rely on package-relative imports.

@@ -1,163 +1,16 @@
-"""Static check: the metric namespace stays coherent.
+"""Compatibility shim: this check now lives in the unified lint plane as
+the `metric-names` rule of tools/edl_lint (docs/STATIC_ANALYSIS.md).
+`make lint` runs `python -m tools.edl_lint` once for every rule; this
+script remains so existing automation invoking it directly keeps
+working."""
 
-Walks every registration call site (`<registry>.counter/gauge/histogram(
-"name", ...)`) in the library via the AST — no imports of jax or the
-modules themselves — and enforces the naming scheme docs/OBSERVABILITY.md
-promises scrapers:
-
-1. every metric name starts with `edl_` (one grep finds the whole
-   framework on a shared Prometheus),
-2. counter names end in `_total` (the convention rate() dashboards key
-   off),
-3. no conflicting registrations: one name must never be registered with
-   two different kinds or label sets anywhere in the tree (the runtime
-   registry raises on the second call — but only on the code path that
-   reaches it; this catches the conflict before any process runs).
-
-Registrations with identical (kind, labels) in more than one module are
-allowed — that is the registry's documented shared-family pattern (e.g.
-`edl_pod_events_total` from both instance managers).
-
-Run by `make lint`; stdlib-only. Exit 1 with a per-violation listing.
-"""
-
-import ast
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
-_KINDS = ("counter", "gauge", "histogram")
-_SCAN_ROOT = os.path.join(REPO, "elasticdl_tpu")
-
-
-def _labelnames(call):
-    """The labelnames tuple of a registration call, as a sorted tuple of
-    literal strings (None when not statically known)."""
-    value = None
-    for kw in call.keywords:
-        if kw.arg == "labelnames":
-            value = kw.value
-    if value is None and len(call.args) >= 3:
-        value = call.args[2]
-    if value is None:
-        return ()
-    if isinstance(value, (ast.Tuple, ast.List)):
-        names = []
-        for elt in value.elts:
-            if not (
-                isinstance(elt, ast.Constant)
-                and isinstance(elt.value, str)
-            ):
-                return None
-            names.append(elt.value)
-        return tuple(names)
-    return None
-
-
-def collect_registrations():
-    """[(name, kind, labels, file, lineno)] for every static call site."""
-    registrations = []
-    for dirpath, dirnames, filenames in os.walk(_SCAN_ROOT):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for filename in filenames:
-            if not filename.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, filename)
-            rel = os.path.relpath(path, REPO)
-            with open(path) as f:
-                try:
-                    tree = ast.parse(f.read(), filename=rel)
-                except SyntaxError as e:
-                    registrations.append(("<syntax error>", str(e), None,
-                                          rel, e.lineno or 0))
-                    continue
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call):
-                    continue
-                func = node.func
-                if not (
-                    isinstance(func, ast.Attribute)
-                    and func.attr in _KINDS
-                ):
-                    continue
-                if not node.args:
-                    continue
-                first = node.args[0]
-                if not (
-                    isinstance(first, ast.Constant)
-                    and isinstance(first.value, str)
-                ):
-                    continue
-                registrations.append(
-                    (
-                        first.value,
-                        func.attr,
-                        _labelnames(node),
-                        rel,
-                        node.lineno,
-                    )
-                )
-    return registrations
-
-
-def check(registrations):
-    errors = []
-    by_name = {}
-    for name, kind, labels, rel, lineno in registrations:
-        where = f"{rel}:{lineno}"
-        if name == "<syntax error>":
-            errors.append(f"{where}: {kind}")
-            continue
-        if not name.startswith("edl_"):
-            errors.append(
-                f"{where}: metric {name!r} must carry the edl_ prefix"
-            )
-        if kind == "counter" and not name.endswith("_total"):
-            errors.append(
-                f"{where}: counter {name!r} must end in _total"
-            )
-        if kind == "histogram" and name.endswith("_total"):
-            errors.append(
-                f"{where}: histogram {name!r} must not end in _total "
-                f"(scrapers infer counters from the suffix)"
-            )
-        prior = by_name.get(name)
-        if prior is None:
-            by_name[name] = (kind, labels, where)
-        else:
-            p_kind, p_labels, p_where = prior
-            same = p_kind == kind and (
-                labels is None
-                or p_labels is None
-                or tuple(labels) == tuple(p_labels)
-            )
-            if not same:
-                errors.append(
-                    f"{where}: metric {name!r} re-registered as "
-                    f"{kind}{labels} — conflicts with {p_kind}"
-                    f"{p_labels} at {p_where} (the runtime registry "
-                    f"will raise on whichever loads second)"
-                )
-    return errors
-
-
-def main():
-    registrations = collect_registrations()
-    errors = check(registrations)
-    if errors:
-        print(f"check_metric_names: {len(errors)} violation(s)")
-        for e in errors:
-            print(f"  {e}")
-        return 1
-    real = [r for r in registrations if r[0] != "<syntax error>"]
-    print(
-        f"check_metric_names: OK "
-        f"({len(real)} registration sites, "
-        f"{len({r[0] for r in real})} metric names)"
-    )
-    return 0
-
+from tools.edl_lint.cli import run  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run(["--rules", "metric-names"]))

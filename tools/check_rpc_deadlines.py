@@ -1,115 +1,16 @@
-"""Static check: no stub call site can escape the deadline/retry plane.
-
-Deadlines and retries are applied centrally — common/rpc.build_channel
-installs the RetryingClientInterceptor (per-method default deadline,
-backoff, circuit breaker) on every channel, and METHOD_POLICIES is the
-per-method matrix. That design reduces "every call site has a timeout" to
-two checkable invariants:
-
-1. EVERY method of every ServiceSpec has an explicit entry in
-   METHOD_POLICIES with a positive deadline (no method silently rides an
-   implicit default).
-2. NO file outside common/rpc.py constructs a raw channel/server/stub
-   (grpc.insecure_channel / grpc.intercept_channel / grpc.server /
-   .unary_unary(...): any of these would bypass the interceptor stack —
-   including the chaos injectors, so an offender would also be invisible
-   to the fault drills).
-
-Run by `make lint` (and fine to run anywhere: imports rpc + stdlib only,
-no jax). Exit 1 with a per-violation listing on failure.
-"""
+"""Compatibility shim: this check now lives in the unified lint plane as
+the `rpc-deadlines` rule of tools/edl_lint (docs/STATIC_ANALYSIS.md).
+`make lint` runs `python -m tools.edl_lint` once for every rule; this
+script remains so existing automation invoking it directly keeps
+working."""
 
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-# Raw-grpc constructions that would bypass the policy interceptors.
-_FORBIDDEN = (
-    re.compile(r"grpc\.insecure_channel\s*\("),
-    re.compile(r"grpc\.secure_channel\s*\("),
-    re.compile(r"grpc\.intercept_channel\s*\("),
-    re.compile(r"grpc\.server\s*\("),
-    re.compile(r"\.unary_unary\s*\("),
-)
-
-# The one module allowed to touch raw grpc construction, and the test/tool
-# files that intentionally build raw fixtures to compare against.
-_ALLOWED = {
-    os.path.join("elasticdl_tpu", "common", "rpc.py"),
-    os.path.join("tools", "check_rpc_deadlines.py"),  # this file's docs
-}
-
-_SCAN_ROOTS = ("elasticdl_tpu", "tools")
-
-
-def check_policy_coverage(errors):
-    from elasticdl_tpu.common import rpc
-
-    for spec in (
-        rpc.MASTER_SERVICE,
-        rpc.PSERVER_SERVICE,
-        rpc.COLLECTIVE_SERVICE,
-    ):
-        for method in spec.methods:
-            policy = rpc.METHOD_POLICIES.get(method)
-            if policy is None:
-                errors.append(
-                    f"{spec.name}/{method}: no entry in "
-                    f"rpc.METHOD_POLICIES (every method needs an explicit "
-                    f"deadline default)"
-                )
-            elif policy.deadline <= 0:
-                errors.append(
-                    f"{spec.name}/{method}: non-positive deadline "
-                    f"{policy.deadline!r}"
-                )
-
-
-def check_no_raw_grpc(errors):
-    for root in _SCAN_ROOTS:
-        for dirpath, dirnames, filenames in os.walk(
-            os.path.join(REPO, root)
-        ):
-            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-            for name in filenames:
-                if not name.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, name)
-                rel = os.path.relpath(path, REPO)
-                if rel in _ALLOWED:
-                    continue
-                with open(path) as f:
-                    for lineno, line in enumerate(f, 1):
-                        stripped = line.strip()
-                        if stripped.startswith("#"):
-                            continue
-                        for pattern in _FORBIDDEN:
-                            if pattern.search(line):
-                                errors.append(
-                                    f"{rel}:{lineno}: raw grpc "
-                                    f"construction ({pattern.pattern}) "
-                                    f"bypasses the rpc deadline/retry "
-                                    f"plane — go through "
-                                    f"common/rpc.build_channel or "
-                                    f"rpc.serve"
-                                )
-
-
-def main():
-    errors = []
-    check_policy_coverage(errors)
-    check_no_raw_grpc(errors)
-    if errors:
-        print(f"check_rpc_deadlines: {len(errors)} violation(s)")
-        for e in errors:
-            print(f"  {e}")
-        return 1
-    print("check_rpc_deadlines: OK")
-    return 0
-
+from tools.edl_lint.cli import run  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run(["--rules", "rpc-deadlines"]))

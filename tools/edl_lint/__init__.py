@@ -1,0 +1,19 @@
+"""edl-lint: the unified static-analysis plane for elasticdl_tpu.
+
+One AST framework (shared module loader, scope/attribute resolver,
+per-file and whole-program passes, inline suppressions, a checked-in
+baseline) hosting every repo invariant that can be enforced without
+running the code — and without importing jax, so `make lint` stays in
+the seconds range on any box:
+
+  concurrency    lock-guard consistency + lock-ordering cycles
+  jit-purity     Python side effects / host syncs inside traced fns
+  env-knobs      ELASTICDL_* reads go through common/knobs.py
+  proto-drift    hand-regenerated pb2 matches the .proto
+  rpc-deadlines  every RPC method has a deadline; no raw grpc
+  metric-names   coherent metric namespace
+  dead-code      unused imports, unreferenced module-level symbols
+
+Run `python -m tools.edl_lint --list-rules` for the catalog and
+docs/STATIC_ANALYSIS.md for the suppression/baseline workflow.
+"""

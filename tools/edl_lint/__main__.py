@@ -1,0 +1,3 @@
+from tools.edl_lint.cli import main
+
+main()

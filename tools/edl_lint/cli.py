@@ -1,0 +1,188 @@
+"""The `python -m tools.edl_lint` entrypoint.
+
+Runs the selected rules over the shared Project cache, applies inline
+suppressions and the checked-in baseline, and reports. Exit 1 on any
+non-baselined finding (or a parse error), 0 otherwise.
+
+Modes:
+  (default)            lint everything
+  PATH [PATH...]       report only findings under the given path prefixes
+  --changed            report only findings in files `git diff` says
+                       changed (analysis stays whole-program, so cross-
+                       file rules still see the full picture)
+  --rules A,B          run only the named rules
+  --list-rules         print the rule catalog and exit
+  --json               machine-readable findings on stdout
+  --write-baseline     regenerate tools/edl_lint/baseline.txt from the
+                       current findings (review the diff!)
+  --no-baseline        ignore the baseline (see every finding)
+  --write-knob-docs    regenerate docs/KNOBS.md from common/knobs.py
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.edl_lint import core  # noqa: E402
+from tools.edl_lint.loader import Project  # noqa: E402
+from tools.edl_lint.rules import ALL_RULES, rule_by_name  # noqa: E402
+
+BASELINE_PATH = os.path.join(REPO, "tools", "edl_lint", "baseline.txt")
+
+
+def _changed_files():
+    """Repo-relative paths git considers changed (working tree + index
+    vs HEAD, plus untracked); None when git is unavailable."""
+    try:
+        tracked = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=REPO, capture_output=True, text=True, timeout=30,
+        )
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=REPO, capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if tracked.returncode != 0:
+        return None
+    paths = set()
+    for out in (tracked.stdout, untracked.stdout):
+        paths.update(
+            os.path.normpath(p) for p in out.splitlines() if p.strip()
+        )
+    return paths
+
+
+def run(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.edl_lint",
+        description="elasticdl_tpu static-analysis plane",
+    )
+    parser.add_argument("paths", nargs="*",
+                        help="restrict REPORTING to these path prefixes")
+    parser.add_argument("--rules", default="",
+                        help="comma-separated rule subset")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    parser.add_argument("--changed", action="store_true",
+                        help="report only findings in git-changed files")
+    parser.add_argument("--write-baseline", action="store_true")
+    parser.add_argument("--no-baseline", action="store_true")
+    parser.add_argument("--write-knob-docs", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for cls in ALL_RULES:
+            print(f"{cls.name:>14}  {' '.join(cls.doc.split())}")
+        return 0
+
+    if args.write_knob_docs:
+        from tools.edl_lint.rules.env_knobs import render_knob_docs
+
+        path = os.path.join(REPO, "docs", "KNOBS.md")
+        with open(path, "w") as f:
+            f.write(render_knob_docs())
+        print(f"wrote {os.path.relpath(path, REPO)}")
+        return 0
+
+    started = time.monotonic()
+    if args.rules:
+        try:
+            selected = [rule_by_name(n.strip())
+                        for n in args.rules.split(",") if n.strip()]
+        except KeyError as e:
+            parser.error(f"unknown rule {e.args[0]!r} "
+                         f"(--list-rules shows the catalog)")
+    else:
+        selected = list(ALL_RULES)
+
+    project = Project.load(REPO)
+    findings = []
+    for cls in selected:
+        findings.extend(cls().check(project))
+    for rel, lineno, message in project.parse_errors:
+        findings.append(core.Finding(
+            "parse", rel, lineno, f"syntax error: {message}",
+            key="syntax-error",
+        ))
+
+    # Inline suppressions.
+    kept = []
+    suppressed = 0
+    for f in findings:
+        sf = project.files.get(f.path)
+        if sf is not None and core.is_suppressed(f, sf.suppressions):
+            suppressed += 1
+        else:
+            kept.append(f)
+    findings = kept
+
+    if args.write_baseline:
+        keys = core.write_baseline(BASELINE_PATH, findings)
+        print(f"wrote {len(keys)} baseline entr"
+              f"{'y' if len(keys) == 1 else 'ies'} to "
+              f"{os.path.relpath(BASELINE_PATH, REPO)}")
+        return 0
+
+    baseline = (
+        set() if args.no_baseline else core.load_baseline(BASELINE_PATH)
+    )
+    fresh = [f for f in findings if f.baseline_key not in baseline]
+    grandfathered = len(findings) - len(fresh)
+
+    # Reporting filters (analysis already ran whole-program).
+    scope_note = ""
+    if args.changed:
+        changed = _changed_files()
+        if changed is not None:
+            fresh = [f for f in fresh if os.path.normpath(f.path)
+                     in changed]
+            scope_note = f" [changed-only: {len(changed)} files]"
+    if args.paths:
+        prefixes = tuple(os.path.normpath(p) for p in args.paths)
+        fresh = [
+            f for f in fresh
+            if os.path.normpath(f.path).startswith(prefixes)
+        ]
+        scope_note += f" [paths: {', '.join(prefixes)}]"
+
+    fresh.sort(key=lambda f: (f.path, f.line, f.rule))
+    elapsed = time.monotonic() - started
+
+    if args.as_json:
+        print(json.dumps(
+            {
+                "findings": [f.as_dict() for f in fresh],
+                "baselined": grandfathered,
+                "suppressed": suppressed,
+                "files_scanned": len(project.files),
+                "rules": [cls.name for cls in selected],
+                "seconds": round(elapsed, 3),
+            },
+            indent=2,
+        ))
+    else:
+        for f in fresh:
+            print(f"{f.path}:{f.line}: [{f.rule}] {f.message}")
+        status = "FAIL" if fresh else "OK"
+        print(
+            f"edl-lint: {status} — {len(fresh)} finding(s), "
+            f"{grandfathered} baselined, {suppressed} suppressed; "
+            f"{len(project.files)} files, "
+            f"{len(selected)} rule(s), {elapsed:.1f}s{scope_note}"
+        )
+    return 1 if fresh else 0
+
+
+def main():
+    sys.exit(run())

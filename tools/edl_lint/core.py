@@ -1,0 +1,115 @@
+"""Core types of the edl-lint plane: findings, rules, suppressions,
+baseline. Stdlib-only; nothing here may import jax (enforced by
+tests/test_edl_lint.py)."""
+
+import re
+
+
+class Finding:
+    """One violation.
+
+    `key` is the STABLE identity used for suppression baselines — it must
+    not contain line numbers (so a baseline survives unrelated edits).
+    Rules pass a symbol-ish key ("Class.attr", "ELASTICDL_FOO", ...); the
+    full baseline key is "<rule>|<path>|<key>".
+    """
+
+    __slots__ = ("rule", "path", "line", "message", "key")
+
+    def __init__(self, rule, path, line, message, key=None):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.message = message
+        self.key = key if key is not None else message
+
+    @property
+    def baseline_key(self):
+        return f"{self.rule}|{self.path}|{self.key}"
+
+    def __repr__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_dict(self):
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "key": self.baseline_key,
+        }
+
+
+class Rule:
+    """A named analysis. Subclasses set `name`/`doc` and implement
+    check(project) -> iterable of Finding."""
+
+    name = ""
+    doc = ""
+
+    def check(self, project):
+        raise NotImplementedError
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*edl-lint:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
+)
+
+
+def parse_suppressions(lines):
+    """{lineno: frozenset(rule names or 'all')} from source lines.
+
+    A `# edl-lint: disable=<rule>[,<rule>...]` comment suppresses matching
+    findings on its own line; when the comment stands alone on the line,
+    it also covers the following line (so long flagged statements keep
+    the annotation above them).
+    """
+    out = {}
+    for lineno, line in enumerate(lines, 1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = frozenset(r.strip() for r in m.group(1).split(","))
+        out[lineno] = out.get(lineno, frozenset()) | rules
+        if line.lstrip().startswith("#"):
+            out[lineno + 1] = out.get(lineno + 1, frozenset()) | rules
+    return out
+
+
+def is_suppressed(finding, suppressions):
+    rules = suppressions.get(finding.line)
+    return bool(rules) and (finding.rule in rules or "all" in rules)
+
+
+def load_baseline(path):
+    """The grandfathered-finding keys, one per line; '#' comments and
+    blank lines ignored. Missing file = empty baseline."""
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except FileNotFoundError:
+        return set()
+    return {
+        line.strip()
+        for line in lines
+        if line.strip() and not line.lstrip().startswith("#")
+    }
+
+
+BASELINE_HEADER = """\
+# edl-lint baseline: grandfathered findings, one stable key per line
+# (rule|path|symbol). A finding whose key appears here is reported as
+# "baselined" and does not fail the run. Regenerate with
+#   python -m tools.edl_lint --write-baseline
+# after REVIEWING that every new entry is a deliberate grandfather, not
+# a fresh regression. Shrink this file whenever you fix an entry.
+"""
+
+
+def write_baseline(path, findings):
+    keys = sorted({f.baseline_key for f in findings})
+    with open(path, "w") as f:
+        f.write(BASELINE_HEADER)
+        for key in keys:
+            f.write(key + "\n")
+    return keys

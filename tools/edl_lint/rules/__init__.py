@@ -1,0 +1,26 @@
+"""Rule registry: every rule module registers its Rule subclass here."""
+
+from tools.edl_lint.rules.concurrency import ConcurrencyRule
+from tools.edl_lint.rules.dead_code import DeadCodeRule
+from tools.edl_lint.rules.env_knobs import EnvKnobsRule
+from tools.edl_lint.rules.jit_purity import JitPurityRule
+from tools.edl_lint.rules.metric_names import MetricNamesRule
+from tools.edl_lint.rules.proto_drift import ProtoDriftRule
+from tools.edl_lint.rules.rpc_deadlines import RpcDeadlinesRule
+
+ALL_RULES = (
+    ConcurrencyRule,
+    JitPurityRule,
+    EnvKnobsRule,
+    ProtoDriftRule,
+    RpcDeadlinesRule,
+    MetricNamesRule,
+    DeadCodeRule,
+)
+
+
+def rule_by_name(name):
+    for cls in ALL_RULES:
+        if cls.name == name:
+            return cls
+    raise KeyError(name)

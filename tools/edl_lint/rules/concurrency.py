@@ -1,0 +1,446 @@
+"""concurrency: lock-guard consistency + lock-ordering cycles.
+
+Part A (per class, whole library): any class that owns a lock
+(`self.X = threading.Lock()/RLock()/Condition()`) has opted its state
+into cross-thread access — so every instance attribute it writes BOTH
+inside and outside `with self.<lock>` blocks is flagged. Writes in
+`__init__`/`__post_init__` are construction (happens-before the thread
+start that publishes the object) and don't count as unguarded. Bodies of
+nested functions (thread targets, callbacks) are analyzed as running
+WITHOUT the locks held at their definition site, because they execute
+later on another thread.
+
+Part B (whole-program, master/ + ps/ + observability/): the
+lock-acquisition graph. Holding lock A while acquiring lock B (directly
+via a nested `with`, or transitively through method calls — including
+calls through constructor-injected collaborators, resolved by class name
+or snake_case parameter naming) adds edge A->B; any cycle is a potential
+deadlock between the gRPC threadpool and the maintenance threads, and is
+rejected.
+"""
+
+import ast
+import os
+
+from tools.edl_lint.core import Finding, Rule
+
+_LOCK_FACTORIES = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+}
+
+# Mutating container methods that count as writes for guard analysis.
+# Queue.put/get are intentionally absent (queue.Queue is itself
+# thread-safe); so are read-only accessors.
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "clear", "update",
+    "setdefault", "pop", "popitem", "add", "discard",
+    "appendleft", "popleft",
+}
+
+_GRAPH_SCOPE = (
+    "elasticdl_tpu/master/",
+    "elasticdl_tpu/ps/",
+    "elasticdl_tpu/observability/",
+)
+
+_INIT_METHODS = {"__init__", "__post_init__"}
+
+
+def _self_attr(node):
+    """'X' when node is `self.X`, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _write_targets(stmt):
+    """Instance attrs written by an Assign/AugAssign/AnnAssign/Delete:
+    plain stores (`self.X = ...`), container-slot stores
+    (`self.X[k] = ...`), and deletes."""
+    attrs = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.Delete):
+        targets = stmt.targets
+    else:
+        return attrs
+    for target in targets:
+        for node in ast.walk(target):
+            attr = _self_attr(node)
+            if attr:
+                attrs.append(attr)
+            elif isinstance(node, ast.Subscript):
+                inner = _self_attr(node.value)
+                if inner:
+                    attrs.append(inner)
+    return attrs
+
+
+class _ClassModel:
+    """Lock attrs, field->class map, and per-method lock/write events for
+    one class."""
+
+    def __init__(self, rel, classdef, minfo, resolver):
+        self.rel = rel
+        self.classdef = classdef
+        self.name = classdef.name
+        self.minfo = minfo
+        self.resolver = resolver
+        self.lock_attrs = set()
+        self.field_classes = {}  # self.<field> -> class name
+        self.methods = {}  # name -> FunctionDef
+        for stmt in classdef.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[stmt.name] = stmt
+        self._find_lock_attrs()
+        self._find_field_classes()
+        # method -> [(held frozenset, event)] where event is
+        # ("acquire", lock, line) | ("write", attr, line) |
+        # ("call", class_name, method_name, line)
+        self.events = {
+            name: self._scan_method(fn)
+            for name, fn in self.methods.items()
+        }
+
+    def _find_lock_attrs(self):
+        for fn in self.methods.values():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not isinstance(node.value, ast.Call):
+                    continue
+                dotted = self.minfo.dotted(node.value.func)
+                if dotted not in _LOCK_FACTORIES:
+                    continue
+                for target in node.targets:
+                    attr = _self_attr(target)
+                    if attr:
+                        self.lock_attrs.add(attr)
+
+    def _find_field_classes(self):
+        known = self.resolver.class_index
+        for fn in self.methods.values():
+            for node in ast.walk(fn):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                ):
+                    continue
+                attr = _self_attr(node.targets[0])
+                if not attr:
+                    continue
+                value = node.value
+                if isinstance(value, ast.Call):
+                    dotted = self.minfo.dotted(value.func) or ""
+                    tail = dotted.rsplit(".", 1)[-1]
+                    if tail in known:
+                        self.field_classes[attr] = tail
+                elif isinstance(value, ast.Name):
+                    # self._task_d = task_dispatcher -> TaskDispatcher
+                    camel = "".join(
+                        p.title() for p in value.id.split("_") if p
+                    )
+                    if camel in known:
+                        self.field_classes[attr] = camel
+
+    # -- per-method event scan -------------------------------------------
+
+    def _scan_method(self, fn):
+        events = []
+        # The repo's `*_locked` suffix convention: the caller already
+        # holds the class's lock(s), so the body is analyzed as guarded.
+        held = (
+            frozenset(self.lock_attrs)
+            if fn.name.endswith("_locked")
+            else frozenset()
+        )
+        self._scan_block(fn.body, held, events)
+        return events
+
+    def _scan_block(self, stmts, held, events):
+        for stmt in stmts:
+            self._scan_stmt(stmt, held, events)
+
+    def _with_locks(self, stmt):
+        locks = []
+        for item in stmt.items:
+            attr = _self_attr(item.context_expr)
+            if attr and attr in self.lock_attrs:
+                locks.append(attr)
+        return locks
+
+    def _scan_stmt(self, stmt, held, events):
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            locks = self._with_locks(stmt)
+            for lock in locks:
+                events.append((held, ("acquire", lock, stmt.lineno)))
+                held = held | {lock}
+            self._scan_block(stmt.body, held, events)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Nested def: runs later (thread target / callback), not
+            # under the locks currently held.
+            self._scan_block(stmt.body, frozenset(), events)
+            return
+        for attr in _write_targets(stmt):
+            events.append((held, ("write", attr, stmt.lineno)))
+        # Recurse into compound-statement blocks, then collect
+        # expression-level events (mutator calls, method calls) from this
+        # statement's own expressions only.
+        for field in ("body", "orelse", "finalbody"):
+            block = getattr(stmt, field, None)
+            if block and all(isinstance(s, ast.stmt) for s in block):
+                self._scan_block(block, held, events)
+        for handler in getattr(stmt, "handlers", ()) or ():
+            self._scan_block(handler.body, held, events)
+        self._scan_exprs(stmt, held, events)
+
+    def _scan_exprs(self, stmt, held, events):
+        """Calls (mutators on self attrs, intra/cross-class methods) in
+        the statement's own expressions — not in nested blocks, which the
+        block walk already covered."""
+        blocks = set()
+        for field in ("body", "orelse", "finalbody"):
+            block = getattr(stmt, field, None)
+            if block:
+                blocks.update(id(s) for s in block)
+        for handler in getattr(stmt, "handlers", ()) or ():
+            blocks.update(id(s) for s in handler.body)
+
+        def walk(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.stmt) and id(child) in blocks:
+                    continue
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)
+                ):
+                    continue
+                if isinstance(child, ast.Call):
+                    self._scan_call(child, held, events)
+                walk(child)
+
+        walk(stmt)
+
+    def _scan_call(self, call, held, events):
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return
+        base = func.value
+        # self.m(...) -> intra-class call
+        attr = _self_attr(func)
+        if attr is not None:
+            if attr in self.methods:
+                events.append(
+                    (held, ("call", self.name, attr, call.lineno))
+                )
+            return
+        # self.<field>.m(...): mutator on own state or collaborator call
+        field = _self_attr(base)
+        if field is None:
+            return
+        if func.attr in _MUTATORS and field not in self.lock_attrs:
+            events.append((held, ("write", field, call.lineno)))
+        target_class = self.field_classes.get(field)
+        if target_class:
+            events.append(
+                (held, ("call", target_class, func.attr, call.lineno))
+            )
+
+
+class ConcurrencyRule(Rule):
+    name = "concurrency"
+    doc = (
+        "Lock-owning classes must write shared attributes consistently "
+        "under their locks, and the whole-program lock-acquisition graph "
+        "(master/, ps/, observability/) must be cycle-free."
+    )
+
+    def check(self, project):
+        resolver = project.resolver
+        models = []
+        for sf in project.iter_files("elasticdl_tpu"):
+            minfo = resolver.module(sf.rel)
+            for classdef in minfo.classes.values():
+                model = _ClassModel(sf.rel, classdef, minfo, resolver)
+                if model.lock_attrs:
+                    models.append(model)
+        yield from self._check_guards(models)
+        yield from self._check_ordering(models)
+
+    # -- Part A: guarded-vs-unguarded writes -----------------------------
+
+    def _check_guards(self, models):
+        for model in models:
+            guarded = {}  # attr -> [line]
+            unguarded = {}
+            for method, events in model.events.items():
+                init = method in _INIT_METHODS
+                for held, event in events:
+                    if event[0] != "write":
+                        continue
+                    _, attr, line = event
+                    if attr in model.lock_attrs:
+                        continue
+                    if held:
+                        guarded.setdefault(attr, []).append(line)
+                    elif not init:
+                        unguarded.setdefault(attr, []).append(line)
+            for attr in sorted(set(guarded) & set(unguarded)):
+                lines = sorted(unguarded[attr])
+                yield Finding(
+                    self.name,
+                    model.rel,
+                    lines[0],
+                    f"{model.name}.{attr} is written under "
+                    f"{model.name}'s lock (line "
+                    f"{sorted(guarded[attr])[0]}) but also without it "
+                    f"(line{'s' if len(lines) > 1 else ''} "
+                    f"{', '.join(map(str, lines))}) — guard every "
+                    f"write or move the attribute out of locked state",
+                    key=f"guard:{model.name}.{attr}",
+                )
+
+    # -- Part B: lock-ordering cycles ------------------------------------
+
+    def _check_ordering(self, models):
+        prefixes = tuple(
+            s.replace("/", os.sep) for s in _GRAPH_SCOPE
+        )
+        in_scope = [m for m in models if m.rel.startswith(prefixes)]
+        by_class = {}
+        for model in in_scope:
+            by_class.setdefault(model.name, model)
+
+        # Transitive "locks this method may acquire" per (class, method),
+        # computed as an iterative fixpoint over the whole call graph —
+        # NOT a memoized DFS, whose cycle cutoff would cache truncated
+        # sets for mutually-recursive methods and silently drop edges.
+        direct = {}  # (cls, method) -> {lock nodes acquired directly}
+        callees = {}  # (cls, method) -> {(cls2, method2) called}
+        for model in in_scope:
+            for method, events in model.events.items():
+                key = (model.name, method)
+                direct.setdefault(key, set())
+                callees.setdefault(key, set())
+                for _, event in events:
+                    if event[0] == "acquire":
+                        direct[key].add(f"{model.name}.{event[1]}")
+                    elif event[0] == "call":
+                        callees[key].add((event[1], event[2]))
+        acquires = {key: set(locks) for key, locks in direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for key, called in callees.items():
+                for callee in called:
+                    extra = acquires.get(callee, ())
+                    if not acquires[key].issuperset(extra):
+                        acquires[key] |= extra
+                        changed = True
+
+        def may_acquire(cls, method):
+            return acquires.get((cls, method), set())
+
+        edges = {}  # (from, to) -> (rel, line)
+        for model in in_scope:
+            for method, events in model.events.items():
+                for held, event in events:
+                    if not held:
+                        continue
+                    held_nodes = [f"{model.name}.{h}" for h in held]
+                    if event[0] == "acquire":
+                        targets = {f"{model.name}.{event[1]}"}
+                        line = event[2]
+                    elif event[0] == "call":
+                        targets = may_acquire(event[1], event[2])
+                        line = event[3]
+                    else:
+                        continue
+                    for h in held_nodes:
+                        for t in targets:
+                            if t != h and (h, t) not in edges:
+                                edges[(h, t)] = (model.rel, line)
+
+        yield from self._report_cycles(edges)
+
+    def _report_cycles(self, edges):
+        graph = {}
+        for (src, dst) in edges:
+            graph.setdefault(src, set()).add(dst)
+        # Tarjan SCC, iterative.
+        index = {}
+        low = {}
+        on_stack = set()
+        stack = []
+        counter = [0]
+        sccs = []
+
+        def strongconnect(v):
+            work = [(v, iter(sorted(graph.get(v, ()))))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(sorted(graph.get(w, ())))))
+                        advanced = True
+                        break
+                    elif w in on_stack:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    if len(scc) > 1:
+                        sccs.append(sorted(scc))
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+
+        for v in sorted(graph):
+            if v not in index:
+                strongconnect(v)
+
+        for scc in sccs:
+            involved = [
+                (pair, where)
+                for pair, where in sorted(edges.items())
+                if pair[0] in scc and pair[1] in scc
+            ]
+            detail = "; ".join(
+                f"{a}->{b} at {rel}:{line}"
+                for (a, b), (rel, line) in involved
+            )
+            rel, line = involved[0][1]
+            yield Finding(
+                self.name,
+                rel,
+                line,
+                f"lock-ordering cycle between {', '.join(scc)} "
+                f"(potential deadlock): {detail}",
+                key=f"cycle:{'|'.join(scc)}",
+            )

@@ -1,0 +1,448 @@
+"""jit-purity: Python side effects inside traced functions.
+
+For every function passed to `jax.jit` / `pjit` / `shard_map` (directly,
+or through grad/vmap/partial wrappers) in worker/, parallel/, and
+layers/, flag code that executes at TRACE time but reads as if it ran
+every step:
+
+- self-mutation (`self.x = ...`, mutator calls on `self.x`) and writes
+  to `nonlocal`/`global` names — state escapes the trace;
+- `time.*` calls, `print`, and logger calls — they fire once per
+  (re)trace, not per step, which is exactly the lie that hides retraced
+  hot steps;
+- host syncs on traced values: `np.asarray`/`np.array`, `float()` /
+  `int()` / `bool()` on arguments (or values derived from them),
+  `.block_until_ready()`, `.item()` — each forces a device round-trip or
+  a ConcretizationError;
+- mutation of closed-over lists/dicts (`acc.append(...)`,
+  `cache[k] = ...` on free variables) — trace-order-dependent state;
+- unhashable static args: call sites passing list/dict/set literals in
+  `static_argnums`/`static_argnames` positions, and mutable defaults on
+  static parameters.
+
+`jax.debug.print` / `jax.debug.callback` are the sanctioned escape
+hatches and are never flagged.
+"""
+
+import ast
+import os
+
+from tools.edl_lint.core import Finding, Rule
+
+_SCOPE = (
+    "elasticdl_tpu/worker/",
+    "elasticdl_tpu/parallel/",
+    "elasticdl_tpu/layers/",
+)
+
+_ENTRY_TAILS = {"jit", "pjit", "shard_map"}
+_WRAPPER_TAILS = {
+    "grad", "value_and_grad", "vmap", "partial", "checkpoint", "remat",
+    "named_call", "custom_vjp", "custom_jvp",
+}
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "clear", "update",
+    "setdefault", "pop", "popitem", "add", "discard",
+    "appendleft", "popleft",
+}
+_HOST_SYNC_FUNCS = {
+    "numpy.asarray", "numpy.array", "numpy.copy", "numpy.float32",
+    "numpy.float64", "numpy.int32", "numpy.int64",
+}
+_HOST_SYNC_METHODS = {"block_until_ready", "item"}
+_CAST_BUILTINS = {"float", "int", "bool"}
+
+
+def _is_jit_entry(dotted):
+    if not dotted:
+        return False
+    tail = dotted.rsplit(".", 1)[-1]
+    if tail not in _ENTRY_TAILS:
+        return False
+    return "jax" in dotted or dotted == tail
+
+
+class _ParentMap:
+    def __init__(self, tree):
+        self.parents = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[id(child)] = node
+
+    def ancestors(self, node):
+        while id(node) in self.parents:
+            node = self.parents[id(node)]
+            yield node
+
+
+def _wrapped_function_expr(call):
+    """The function expression a jit/pjit/shard_map call wraps."""
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg in ("fun", "f"):
+            return kw.value
+    return None
+
+
+class JitPurityRule(Rule):
+    name = "jit-purity"
+    doc = (
+        "Functions handed to jax.jit/pjit/shard_map must be free of "
+        "Python side effects, host syncs, and unhashable static args."
+    )
+
+    def check(self, project):
+        resolver = project.resolver
+        seen = set()
+        prefixes = tuple(s.replace("/", os.sep) for s in _SCOPE)
+        for sf in project.iter_files():
+            if not sf.rel.startswith(prefixes):
+                continue
+            minfo = resolver.module(sf.rel)
+            parents = _ParentMap(sf.tree)
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = minfo.dotted(node.func)
+                if not _is_jit_entry(dotted):
+                    continue
+                fn_expr = _wrapped_function_expr(node)
+                target = self._resolve_function(
+                    fn_expr, node, sf, minfo, parents
+                )
+                if target is not None:
+                    for f in self._analyze(target, sf, minfo):
+                        marker = (f.path, f.line, f.message)
+                        if marker not in seen:
+                            seen.add(marker)
+                            yield f
+                yield from self._check_static_args(node, sf, minfo,
+                                                  parents, target)
+
+    # -- resolution ------------------------------------------------------
+
+    def _resolve_function(self, expr, call, sf, minfo, parents):
+        depth = 0
+        while isinstance(expr, ast.Call) and depth < 4:
+            tail = (minfo.dotted(expr.func) or "").rsplit(".", 1)[-1]
+            if tail in _WRAPPER_TAILS and expr.args:
+                expr = expr.args[0]
+                depth += 1
+            else:
+                return None
+        if expr is None:
+            return None
+        if isinstance(expr, ast.Lambda):
+            return expr
+        if isinstance(expr, ast.Name):
+            return self._find_def(expr.id, call, sf)
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+        ):
+            for anc in parents.ancestors(call):
+                if isinstance(anc, ast.ClassDef):
+                    for stmt in anc.body:
+                        if (
+                            isinstance(stmt, ast.FunctionDef)
+                            and stmt.name == expr.attr
+                        ):
+                            return stmt
+                    return None
+        return None
+
+    def _find_def(self, name, call, sf):
+        candidates = [
+            n
+            for n in ast.walk(sf.tree)
+            if isinstance(n, ast.FunctionDef) and n.name == name
+        ]
+        if not candidates:
+            return None
+        preceding = [c for c in candidates if c.lineno <= call.lineno]
+        pool = preceding or candidates
+        return max(pool, key=lambda c: c.lineno)
+
+    # -- purity analysis -------------------------------------------------
+
+    def _analyze(self, fn, sf, minfo):
+        if isinstance(fn, ast.Lambda):
+            params = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+            body_nodes = [fn.body]
+            fn_name = "<lambda>"
+        else:
+            params = {
+                a.arg
+                for a in fn.args.args
+                + fn.args.kwonlyargs
+                + fn.args.posonlyargs
+            }
+            body_nodes = fn.body
+            fn_name = fn.name
+        params.discard("self")
+
+        local_names = set(params)
+        escaping = set()  # nonlocal/global declarations
+        for node in body_nodes:
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Nonlocal, ast.Global)):
+                    escaping.update(sub.names)
+                elif isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                local_names.add(n.id)
+                elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                    if isinstance(sub.target, ast.Name):
+                        local_names.add(sub.target.id)
+                elif isinstance(sub, (ast.For, ast.comprehension)):
+                    tgt = sub.target
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            local_names.add(n.id)
+                elif isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    local_names.add(sub.name)
+                    for a in sub.args.args + sub.args.kwonlyargs:
+                        local_names.add(a.arg)
+
+        tainted = set(params)
+        for _ in range(2):
+            for node in body_nodes:
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign):
+                        names = {
+                            n.id
+                            for n in ast.walk(sub.value)
+                            if isinstance(n, ast.Name)
+                        }
+                        if names & tainted:
+                            for t in sub.targets:
+                                for n in ast.walk(t):
+                                    if isinstance(n, ast.Name):
+                                        tainted.add(n.id)
+
+        def is_tainted(expr):
+            return any(
+                isinstance(n, ast.Name) and n.id in tainted
+                for n in ast.walk(expr)
+            )
+
+        def flag(node, what, key):
+            return Finding(
+                self.name,
+                sf.rel,
+                node.lineno,
+                f"in jitted `{fn_name}`: {what}",
+                key=f"{fn_name}:{key}",
+            )
+
+        for node in body_nodes:
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Assign, ast.AugAssign,
+                                    ast.AnnAssign)):
+                    targets = (
+                        sub.targets
+                        if isinstance(sub, ast.Assign)
+                        else [sub.target]
+                    )
+                    for t in targets:
+                        for n in ast.walk(t):
+                            if (
+                                isinstance(n, ast.Attribute)
+                                and isinstance(n.value, ast.Name)
+                                and n.value.id == "self"
+                            ):
+                                yield flag(
+                                    sub,
+                                    f"writes self.{n.attr} (state "
+                                    f"escapes the trace; runs once per "
+                                    f"retrace, not per step)",
+                                    f"self.{n.attr}",
+                                )
+                            elif (
+                                isinstance(n, ast.Name)
+                                and n.id in escaping
+                            ):
+                                yield flag(
+                                    sub,
+                                    f"writes nonlocal/global "
+                                    f"`{n.id}` (trace-time side "
+                                    f"effect)",
+                                    f"escape:{n.id}",
+                                )
+                            elif (
+                                isinstance(n, ast.Subscript)
+                                and isinstance(n.value, ast.Name)
+                                and n.value.id not in local_names
+                            ):
+                                yield flag(
+                                    sub,
+                                    f"mutates closed-over "
+                                    f"`{n.value.id}[...]` (trace-"
+                                    f"order-dependent state)",
+                                    f"closure:{n.value.id}",
+                                )
+                elif isinstance(sub, ast.Call):
+                    yield from self._check_call(
+                        sub, sf, minfo, local_names, is_tainted, flag
+                    )
+
+    def _check_call(self, call, sf, minfo, local_names, is_tainted, flag):
+        dotted = minfo.dotted(call.func) or ""
+        if dotted.startswith("jax.debug"):
+            return
+        if dotted.startswith("time."):
+            yield flag(
+                call,
+                f"calls {dotted} (fires at trace time only; use "
+                f"jax.debug.callback for per-step host work)",
+                f"time:{dotted}",
+            )
+            return
+        if dotted == "print" or dotted.startswith("logging."):
+            yield flag(
+                call,
+                f"calls {dotted} (runs once per retrace — use "
+                f"jax.debug.print for per-step output)",
+                f"log:{dotted}",
+            )
+            return
+        if dotted in _HOST_SYNC_FUNCS:
+            if call.args and is_tainted(call.args[0]):
+                yield flag(
+                    call,
+                    f"calls {dotted} on a traced value (host sync / "
+                    f"ConcretizationError)",
+                    f"sync:{dotted}",
+                )
+            return
+        if dotted in _CAST_BUILTINS:
+            if call.args and is_tainted(call.args[0]):
+                yield flag(
+                    call,
+                    f"calls {dotted}() on a traced value (forces a "
+                    f"host sync)",
+                    f"cast:{dotted}",
+                )
+            return
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in _HOST_SYNC_METHODS:
+                yield flag(
+                    call,
+                    f".{func.attr}() inside a jitted function (host "
+                    f"sync)",
+                    f"sync:.{func.attr}",
+                )
+                return
+            base = func.value
+            if (
+                isinstance(base, ast.Name)
+                and base.id in minfo.loggers
+            ):
+                yield flag(
+                    call,
+                    f"calls logger.{func.attr}() (runs once per "
+                    f"retrace — use jax.debug.print)",
+                    f"log:logger.{func.attr}",
+                )
+                return
+            if (
+                func.attr in _MUTATORS
+                and isinstance(base, ast.Name)
+                and base.id not in local_names
+            ):
+                yield flag(
+                    call,
+                    f"mutates closed-over `{base.id}.{func.attr}(...)` "
+                    f"(trace-order-dependent state)",
+                    f"closure:{base.id}",
+                )
+
+    # -- static-arg hashability ------------------------------------------
+
+    def _check_static_args(self, call, sf, minfo, parents, target):
+        static_names = set()
+        static_nums = []
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(
+                        n.value, str
+                    ):
+                        static_names.add(n.value)
+            elif kw.arg == "static_argnums":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(
+                        n.value, int
+                    ):
+                        static_nums.append(n.value)
+        if not static_names and not static_nums:
+            return
+        # Mutable defaults on static parameters of the wrapped function.
+        if isinstance(target, ast.FunctionDef):
+            args = target.args
+            pos = args.posonlyargs + args.args
+            defaults = [None] * (len(pos) - len(args.defaults)) + list(
+                args.defaults
+            )
+            for i, (arg, default) in enumerate(zip(pos, defaults)):
+                static = arg.arg in static_names or i in static_nums
+                if static and isinstance(
+                    default, (ast.List, ast.Dict, ast.Set)
+                ):
+                    yield Finding(
+                        self.name,
+                        sf.rel,
+                        target.lineno,
+                        f"static arg `{arg.arg}` of jitted "
+                        f"`{target.name}` has an unhashable "
+                        f"(list/dict/set) default — every call "
+                        f"retraces or raises",
+                        key=f"{target.name}:static:{arg.arg}",
+                    )
+        # Call sites: the jitted callable bound to a name, then invoked
+        # with a literal list/dict/set in a static position.
+        parent = parents.parents.get(id(call))
+        if not (
+            isinstance(parent, ast.Assign)
+            and len(parent.targets) == 1
+            and isinstance(parent.targets[0], ast.Name)
+        ):
+            return
+        bound = parent.targets[0].id
+        for node in ast.walk(sf.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == bound
+            ):
+                continue
+            for i, arg in enumerate(node.args):
+                if i in static_nums and isinstance(
+                    arg, (ast.List, ast.Dict, ast.Set)
+                ):
+                    yield Finding(
+                        self.name,
+                        sf.rel,
+                        node.lineno,
+                        f"unhashable literal passed in static position "
+                        f"{i} of jitted `{bound}`",
+                        key=f"{bound}:staticcall:{i}",
+                    )
+            for kw in node.keywords:
+                if kw.arg in static_names and isinstance(
+                    kw.value, (ast.List, ast.Dict, ast.Set)
+                ):
+                    yield Finding(
+                        self.name,
+                        sf.rel,
+                        node.lineno,
+                        f"unhashable literal passed as static arg "
+                        f"`{kw.arg}` of jitted `{bound}`",
+                        key=f"{bound}:staticcall:{kw.arg}",
+                    )
