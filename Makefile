@@ -16,9 +16,18 @@ test:
 verify: lint
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
-# Harness self-check: tiny shapes, CPU-safe, < 60 s, per-bench watchdog.
+# Harness self-check: tiny shapes, CPU-safe, < 60 s, per-bench watchdog,
+# CI fields + the push serialize/wire/apply breakdown included.
 bench-smoke:
-	JAX_PLATFORMS=cpu python bench.py --smoke
+	JAX_PLATFORMS=cpu python -m elasticdl_tpu.bench --smoke
+
+# The regression gate: newest parseable BENCH_r*.json vs the previous
+# one; exits nonzero ONLY on a statistically significant practical
+# regression (bootstrap CI excludes zero AND effect >= min-effect).
+# Different-device pairs and timeout wrappers pass/skip automatically.
+# docs/BENCHMARKS.md has the methodology.
+bench-gate:
+	python -m elasticdl_tpu.bench.gate
 
 # The unified static-analysis plane (tools/edl_lint, no jax import,
 # seconds not minutes): concurrency (lock guards + ordering cycles),
@@ -47,4 +56,4 @@ obs:
 native:
 	@if [ -f elasticdl_tpu/native/Makefile ]; then $(MAKE) -C elasticdl_tpu/native; else echo "native kernels not present yet"; fi
 
-.PHONY: proto test verify bench-smoke lint lint-changed chaos obs native
+.PHONY: proto test verify bench-smoke bench-gate lint lint-changed chaos obs native

@@ -1,575 +1,21 @@
-"""Benchmark entrypoint: prints ONE JSON line
+"""Benchmark entrypoint (thin shim): prints ONE JSON line
 {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "details": {...}}.
 
-North-star configs (BASELINE.json): ResNet50-ImageNet and DeepFM-Criteo
-examples/sec/chip. The primary metric is ResNet50 train throughput per chip
-(bf16, synthetic ImageNet shapes, batch 128) against the reference's best
-published single-accelerator figure — 145 img/s on one P100
-(BASELINE.md, ftlib_benchmark.md:114-135). details carries step time, an
-MFU estimate from XLA's own cost analysis, and the DeepFM-Criteo number.
-
-Method: the batch is placed on device once and the jitted train step runs
-in a loop with donated buffers (synthetic-data-resident mode, as in MLPerf
-synthetic runs) — measuring the training step, not host dataloading.
+The implementation lives in the ``elasticdl_tpu/bench/`` package — a
+budget-aware runner with per-benchmark watchdogs, repeated timed
+windows with bootstrap confidence intervals, a significance verdict
+vs the latest checked-in BENCH_*.json, the PS-mode microbench matrix
+(wire codec x push pipelining x shard count, each cell with the
+push_gradients serialize/wire/apply breakdown), and a flight recorder
+so a killed run leaves attributable evidence. See docs/BENCHMARKS.md
+for the methodology and ``python -m elasticdl_tpu.bench --help`` for
+the flags; this shim exists because the driver invokes
+``python bench.py``.
 """
 
-import json
-import os
-import time
+import sys
 
-import jax
-import numpy as np
-
-# Peak dense bf16 FLOP/s by device kind (public spec sheets), for the MFU
-# denominator. Override with EDL_PEAK_TFLOPS for unlisted hardware.
-PEAK_TFLOPS_BY_KIND = {
-    "TPU v4": 275.0,
-    "TPU v5 lite": 197.0,
-    "TPU v5e": 197.0,
-    "TPU v5": 459.0,
-    "TPU v5p": 459.0,
-    "TPU v6 lite": 918.0,
-    "TPU v6e": 918.0,
-}
-
-
-def _peak_flops():
-    env = os.environ.get("EDL_PEAK_TFLOPS")
-    if env:
-        return float(env) * 1e12
-    kind = jax.devices()[0].device_kind
-    tflops = PEAK_TFLOPS_BY_KIND.get(kind)
-    return tflops * 1e12 if tflops else None
-
-
-def _time_step_loop(trainer, features, labels, steps, warmup):
-    """Build the trainer's jitted step, park the batch on device, loop with
-    donated buffers. Returns (elapsed_s, flops_per_step or None)."""
-    trainer.init_variables_if_needed(features)
-    step = trainer._train_step
-    variables, opt_state = trainer._variables, trainer._opt_state
-    rng = jax.random.PRNGKey(0)
-    dev_f = jax.device_put(features)
-    dev_l = jax.device_put(labels)
-
-    flops = None
-    try:
-        cost = step.lower(
-            variables, opt_state, rng, dev_f, dev_l
-        ).compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        flops = float(cost.get("flops", 0.0)) or None
-    except Exception:
-        pass
-
-    for _ in range(warmup):
-        variables, opt_state, loss = step(
-            variables, opt_state, rng, dev_f, dev_l
-        )
-    # On tunneled device platforms block_until_ready can return at dispatch;
-    # a scalar host read is the only sync that provably waits for execution.
-    float(loss)
-
-    start = time.perf_counter()
-    for _ in range(steps):
-        variables, opt_state, loss = step(
-            variables, opt_state, rng, dev_f, dev_l
-        )
-    float(loss)  # force completion of the whole chain (4-byte transfer)
-    return time.perf_counter() - start, flops
-
-
-def _bench_image_model(model_def, batch_size, steps, warmup):
-    """Shared ImageNet-shape image benchmark: examples/sec, step time, and
-    (when XLA cost analysis yields flops) TFLOP/s + MFU."""
-    from elasticdl_tpu.common.model_utils import get_model_spec
-    from elasticdl_tpu.worker.trainer import LocalTrainer
-
-    spec = get_model_spec(model_def)
-    trainer = LocalTrainer(
-        spec.build_model(), spec.loss, spec.build_optimizer_spec()
-    )
-    rng = np.random.default_rng(0)
-    features = rng.normal(size=(batch_size, 224, 224, 3)).astype(np.float32)
-    labels = rng.integers(0, 1000, batch_size).astype(np.int64)
-    elapsed, flops = _time_step_loop(trainer, features, labels, steps, warmup)
-    out = {
-        "examples_per_sec": batch_size * steps / elapsed,
-        "step_time_ms": elapsed / steps * 1e3,
-    }
-    if flops:
-        out["model_tflops_per_sec"] = flops * steps / elapsed / 1e12
-        peak = _peak_flops()
-        if peak:
-            out["mfu"] = flops * steps / elapsed / peak
-    return out
-
-
-def bench_resnet50(batch_size=128, steps=30, warmup=5):
-    return _bench_image_model(
-        "elasticdl_tpu.models.resnet50.resnet50", batch_size, steps, warmup
-    )
-
-
-def bench_mobilenetv2(batch_size=256, steps=30, warmup=5):
-    """Second image benchmark of the reference's table: MobileNetV2 at
-    150 img/s on one P100 (ftlib_benchmark.md:138-156)."""
-    out = _bench_image_model(
-        "elasticdl_tpu.models.mobilenetv2.mobilenetv2",
-        batch_size,
-        steps,
-        warmup,
-    )
-    out["vs_p100_150img_s"] = out["examples_per_sec"] / 150.0
-    return out
-
-
-def bench_deepfm_criteo(batch_size=32768, steps=30, warmup=5):
-    """Batch 32768: measured sweep on TPU v5e — 197k ex/s @8192, 199k
-    @16384, 211k @32768 (embedding gathers amortize better at width);
-    large batches are the normal recsys regime on TPU."""
-    from elasticdl_tpu.common.model_utils import get_model_spec
-    from elasticdl_tpu.models.dac_ctr.transform import NUM_FIELDS, TOTAL_IDS
-    from elasticdl_tpu.worker.trainer import LocalTrainer
-
-    spec = get_model_spec("elasticdl_tpu.models.dac_ctr.deepfm")
-    trainer = LocalTrainer(
-        spec.build_model(), spec.loss, spec.build_optimizer_spec()
-    )
-    rng = np.random.default_rng(0)
-    features = {
-        "dense": rng.normal(size=(batch_size, 13)).astype(np.float32),
-        "ids": rng.integers(
-            0, TOTAL_IDS, size=(batch_size, NUM_FIELDS)
-        ).astype(np.int32),
-    }
-    labels = rng.integers(0, 2, batch_size).astype(np.int64)
-    elapsed, _ = _time_step_loop(trainer, features, labels, steps, warmup)
-    return {
-        "examples_per_sec": batch_size * steps / elapsed,
-        "step_time_ms": elapsed / steps * 1e3,
-    }
-
-
-def _device_transfer_mb_per_s(mb=8):
-    """One d2h round of `mb` MB: the PS bench's measured limiter on
-    tunnel-attached chips (PERF_SNAPSHOT ps_push_decomposition). Recorded
-    as session context so a flagged/slow PS result can be attributed to
-    the environment; None off-device."""
-    try:
-        import jax
-        import jax.numpy as jnp
-
-        if jax.default_backend() == "cpu":
-            return None
-        n = mb * (1 << 20) // 4
-        best = float("inf")
-        for i in range(2):
-            x = jax.block_until_ready(
-                jnp.ones((n,), jnp.float32) * (i + 1)
-            )
-            t0 = time.perf_counter()
-            np.asarray(x)  # forced host materialization
-            best = min(best, time.perf_counter() - t0)
-        return round(mb / best, 1)
-    except Exception:
-        return None
-
-
-def run_with_watchdog(name, fn, timeout_s):
-    """Run one benchmark with a hard wall-clock bound (the BENCH_r05 fix:
-    a wedged config must surface as {"error": "...timeout"} in its own
-    slot, not eat the whole run's budget as an rc=124). The benchmark runs
-    on a daemon thread; on timeout the thread is abandoned — it can't be
-    killed, but the run moves on and the process can still exit."""
-    if not timeout_s:
-        try:
-            return fn()
-        except Exception as e:
-            return {"error": str(e)[:200]}
-    import threading
-
-    box = {}
-
-    def target():
-        try:
-            box["result"] = fn()
-        except Exception as e:
-            box["error"] = str(e)[:200]
-
-    thread = threading.Thread(
-        target=target, name=f"bench-{name}", daemon=True
-    )
-    thread.start()
-    thread.join(timeout_s)
-    if thread.is_alive():
-        return {
-            "error": f"watchdog timeout after {timeout_s:g}s",
-            "timed_out": True,
-        }
-    if "error" in box:
-        return {"error": box["error"]}
-    return box.get("result")
-
-
-def aggregate_runs(runs, spread_gate=1.25, key="examples_per_sec"):
-    """Median-of-N reporting with an explicit outlier flag (VERDICT r4
-    #2): the headline is the median run's rate, the reported phase
-    breakdown is the run closest to the median (so phases and headline
-    describe the same execution), the full run list is always recorded,
-    and a max/min spread beyond `spread_gate` marks the result as
-    contaminated by host load instead of silently max- or mean-ing it."""
-    import statistics
-
-    rates = [r[key] for r in runs]
-    med = statistics.median(rates)
-    rep = dict(min(runs, key=lambda r: abs(r[key] - med)))
-    rep[key] = med
-    rep["runs_" + key] = [round(r, 1) for r in rates]
-    spread = max(rates) / max(min(rates), 1e-9)
-    rep["run_spread"] = round(spread, 3)
-    if spread > spread_gate:
-        rep["spread_exceeds_gate"] = True
-        rep["loadavg_at_flag"] = os.getloadavg()[0]
-    return rep
-
-
-def bench_deepfm_ps(batch_size=16384, steps=6, warmup=4, num_ps=2,
-                    repeats=3, spread_gate=1.25):
-    # warmup=4 covers each of the 4 distinct id batches once, so measured
-    # steps hit warm PS rows (the r4 run-to-run spread — 3.6k vs 7.2k on
-    # identical configs — was cold-row lazy init landing inside the timed
-    # window of whichever run compiled first).
-    # Batch 16384, not smaller: the push-thread overlap needs enough
-    # per-step RPC work to amortize its contention with prefetch on a
-    # single-core host (measured 1.22x at 16384 but 0.92x at 8192).
-    """The other half of the DeepFM north star (BASELINE.json: "large
-    embedding_service + elastic worker preemption"): DeepFM with its
-    wide/deep tables PS-RESIDENT on 2 real localhost PS shards (native
-    C++ id map + kernels), one TPU worker pulling rows / pushing
-    IndexedSlices per step (models/dac_ctr/deepfm_ps). Four configs:
-    the serialized loop (f32 and bf16 wire) and the pipelined async
-    path (push on a background thread) x the same wire dtypes.
-
-    Reporting (VERDICT r4 #2): every config runs `repeats >= 3` times and
-    the headline is the MEDIAN run (its phase breakdown is the run
-    closest to the median). The full run list is always recorded, and a
-    max/min spread beyond `spread_gate` flags the config as
-    "spread_exceeds_gate" with the host loadavg — this bench shares one
-    host core with both PS shards and the worker codec, so a transient
-    host spike shows up as a flagged outlier instead of silently
-    inflating (best-of-N) or deflating (mean) the number."""
-    from elasticdl_tpu.common.model_utils import get_model_spec
-    from elasticdl_tpu.models.dac_ctr.transform import NUM_FIELDS, TOTAL_IDS
-    from elasticdl_tpu.ps.parameter_server import ParameterServer
-    from elasticdl_tpu.worker.ps_client import PSClient
-    from elasticdl_tpu.worker.ps_trainer import ParameterServerTrainer
-
-    spec = get_model_spec("elasticdl_tpu.models.dac_ctr.deepfm_ps")
-    rng = np.random.default_rng(0)
-    n_batches = 4  # distinct id sets so pulls stay realistic
-    batches = []
-    for _ in range(n_batches):
-        features = {
-            "dense": rng.normal(size=(batch_size, 13)).astype(np.float32),
-            "ids": rng.integers(
-                0, TOTAL_IDS, size=(batch_size, NUM_FIELDS)
-            ).astype(np.int32),
-        }
-        labels = rng.integers(0, 2, batch_size).astype(np.int64)
-        batches.append((features, labels))
-
-    def run_once(pipelined, wire_dtype):
-        servers = [
-            ParameterServer(
-                i, num_ps, optimizer_spec=spec.build_optimizer_spec()
-            )
-            for i in range(num_ps)
-        ]
-        client = None
-        trainer = None
-        try:
-            client = PSClient(
-                [s.addr for s in servers], worker_id=0,
-                wire_dtype=wire_dtype,
-            )
-            trainer = ParameterServerTrainer(
-                spec.build_model(),
-                spec.loss,
-                spec.build_optimizer_spec(),
-                client,
-                embedding_inputs=spec.module.embedding_inputs,
-                pipeline_pushes=pipelined,
-            )
-            for i in range(warmup):
-                f, l = batches[i % n_batches]
-                trainer.train_minibatch(f, l)
-            trainer._flush_pushes()
-            trainer.timing.reset()
-            start = time.perf_counter()
-            loss = None
-            for i in range(steps):
-                f, l = batches[i % n_batches]
-                _, _, loss = trainer.train_minibatch(f, l)
-            float(loss)
-            trainer._flush_pushes()
-            elapsed = time.perf_counter() - start
-            phases = {
-                phase: round(s["mean_s"] * 1e3, 2)
-                for phase, s in trainer.timing.summary().items()
-            }
-            return {
-                "examples_per_sec": batch_size * steps / elapsed,
-                "step_time_ms": elapsed / steps * 1e3,
-                "phase_mean_ms": phases,
-            }
-        finally:
-            if trainer is not None:
-                trainer.close()
-            if client is not None:
-                client.close()
-            for s in servers:
-                s.stop()
-
-    configs = (
-        ("serialized", False, "float32"),
-        # bf16 wire is now device-native (round 5): rows upload bf16 and
-        # the step emits bf16 row grads, so BOTH host<->device hops move
-        # half the bytes — on tunnel-attached chips those hops are the
-        # step's measured limiter (tools/ps_push_probe.py).
-        ("serialized_bf16_wire", False, "bfloat16"),
-        ("pipelined", True, "float32"),
-        ("pipelined_bf16_wire", True, "bfloat16"),
-    )
-    out = {
-        "median_of_n": repeats,
-        "spread_gate": spread_gate,
-        "loadavg_start": os.getloadavg()[0],
-        # Context for flagged runs: this bench's limiter is the
-        # host<->device hop, and on tunnel-attached chips its bandwidth
-        # fluctuates session to session — record it like loadavg.
-        "device_transfer_mb_per_s": _device_transfer_mb_per_s(),
-    }
-    for name, pipelined, wire in configs:
-        runs = [run_once(pipelined, wire) for _ in range(repeats)]
-        agg = aggregate_runs(runs, spread_gate)
-        if agg.get("spread_exceeds_gate"):
-            # More samples, same estimator: a transient host/tunnel spike
-            # in a 3-run session can leave the median itself suspect; two
-            # extra runs make it robust while the full (5-run) list and
-            # spread stay recorded. Not best-of — the median is over ALL
-            # runs.
-            runs += [run_once(pipelined, wire) for _ in range(2)]
-            agg = aggregate_runs(runs, spread_gate)
-            agg["extended_to_n"] = len(runs)
-        out[name] = agg
-    out["loadavg_end"] = os.getloadavg()[0]
-    if out.get("serialized", {}).get("examples_per_sec"):
-        # Derived ratios inherit contamination: a gate-flagged median
-        # must not silently feed a clean-looking headline speedup.
-        def ratio(num, den):
-            value = (
-                out[num]["examples_per_sec"]
-                / out[den]["examples_per_sec"]
-            )
-            flagged = any(
-                out[c].get("spread_exceeds_gate") for c in (num, den)
-            )
-            return value, flagged
-
-        out["overlap_speedup"], flagged = ratio("pipelined", "serialized")
-        if flagged:
-            out["overlap_speedup_contaminated"] = True
-        out["bf16_wire_speedup"], flagged = ratio(
-            "serialized_bf16_wire", "serialized"
-        )
-        if flagged:
-            out["bf16_wire_speedup_contaminated"] = True
-    return out
-
-
-def bench_elastic_rejoin():
-    """The third north-star metric (BASELINE.json): seconds for a job that
-    loses a worker to SIGKILL to have its replacement back in the job
-    (detection + task recovery + relaunch + re-init + first RPC).
-    Runs the real CLI cluster on the CPU platform so it never contends
-    with the TPU benchmarks; rejoin time is control-plane latency."""
-    import subprocess
-    import sys
-    import tempfile
-
-    repo = os.path.dirname(os.path.abspath(__file__))
-    try:
-        sys.path.insert(0, os.path.join(repo, "tools"))
-        sys.path.insert(0, os.path.join(repo, "tests"))
-        import test_module
-        from elastic_drill import run_drill
-
-        from elasticdl_tpu.data.recordfile import RecordFileWriter
-
-        with tempfile.TemporaryDirectory() as d:
-            data = os.path.join(d, "linear.edlr")
-            with RecordFileWriter(data) as w:
-                for r in test_module.make_linear_records(256):
-                    w.write(r)
-            # Best-of-2: rejoin time is control-plane latency on a shared
-            # single-core host; one run can absorb seconds of unrelated
-            # load (VERDICT r3 asked every host-bound bench for best-of-N).
-            results = [
-                run_drill(
-                    data,
-                    model_zoo=os.path.join(repo, "tests"),
-                    model_def="test_module",
-                    num_workers=2,
-                    num_ps=1,
-                    num_epochs=300,
-                    env_overrides={"JAX_PLATFORMS": "cpu"},
-                    timeout=600,
-                )
-                for _ in range(2)
-            ]
-        ok = [r for r in results if r.get("rejoin_s") is not None]
-        best = min(ok, key=lambda r: r["rejoin_s"]) if ok else results[0]
-        return {
-            "rejoin_s": best.get("rejoin_s"),
-            "rejoin_s_runs": [r.get("rejoin_s") for r in results],
-            "best_of_n": 2,
-            "completed": best.get("completed"),
-            "relaunched": best.get("relaunched"),
-        }
-    except Exception as e:  # never let the drill sink the whole bench
-        return {"rejoin_s": None, "error": str(e)[:200]}
-
-
-def _round_if_ok(result):
-    if not isinstance(result, dict) or "error" in result:
-        return result
-    return {
-        k: (round(v, 4) if isinstance(v, float) else v)
-        for k, v in result.items()
-    }
-
-
-def main_smoke(watchdog_s):
-    """CPU-safe tiny-shape pass (< 60 s): exercises every bench pipeline —
-    image model, dense DeepFM, PS-resident DeepFM over a real localhost
-    shard — without TPU-scale shapes or the elastic drill. This is the CI
-    guard for bench.py itself: a hang or crash in the harness shows up
-    here in seconds, not at the end of a multi-hour TPU session."""
-    start = time.perf_counter()
-    # Conv backbones are out: their CPU compile alone blows the budget.
-    # The two DeepFM benches still cover both execution pipelines (the
-    # jitted LocalTrainer loop and the PS pull/train/push loop).
-    benches = {
-        "deepfm_criteo_b256": lambda: bench_deepfm_criteo(
-            batch_size=256, steps=2, warmup=1
-        ),
-        "deepfm_ps_b128": lambda: bench_deepfm_ps(
-            batch_size=128, steps=2, warmup=1, num_ps=1, repeats=1,
-        ),
-    }
-    details = {}
-    failures = 0
-    for name, fn in benches.items():
-        result = run_with_watchdog(name, fn, watchdog_s)
-        details[name] = _round_if_ok(result)
-        if not isinstance(result, dict) or "error" in result:
-            failures += 1
-    elapsed = time.perf_counter() - start
-    details["elapsed_s"] = round(elapsed, 2)
-    details["failures"] = failures
-    print(
-        json.dumps(
-            {
-                "metric": "bench smoke (tiny shapes, CPU-safe)",
-                "value": round(elapsed, 2),
-                "unit": "seconds",
-                "vs_baseline": None,
-                "details": details,
-            }
-        )
-    )
-    return 1 if failures else 0
-
-
-def main(argv=None):
-    import argparse
-
-    parser = argparse.ArgumentParser("bench")
-    parser.add_argument(
-        "--smoke",
-        action="store_true",
-        help="tiny shapes, CPU-safe, exits < 60 s (harness self-check)",
-    )
-    parser.add_argument(
-        "--watchdog_s",
-        type=float,
-        default=None,
-        help="per-benchmark wall-clock bound (default 600, 50 with "
-        "--smoke; 0 disables): one wedged config cannot eat the run",
-    )
-    args = parser.parse_args(argv)
-    watchdog_s = (
-        args.watchdog_s
-        if args.watchdog_s is not None
-        else (50.0 if args.smoke else 600.0)
-    )
-    if args.smoke:
-        return main_smoke(watchdog_s)
-
-    resnet = run_with_watchdog("resnet50", bench_resnet50, watchdog_s)
-    mobilenet = run_with_watchdog(
-        "mobilenetv2", bench_mobilenetv2, watchdog_s
-    )
-    deepfm = run_with_watchdog(
-        "deepfm_criteo", bench_deepfm_criteo, watchdog_s
-    )
-    deepfm_ps = run_with_watchdog(
-        "deepfm_ps", bench_deepfm_ps, watchdog_s
-    )
-    elastic = run_with_watchdog(
-        "elastic_rejoin",
-        bench_elastic_rejoin,
-        # The drill legitimately runs minutes (two full kill/rejoin jobs);
-        # never bound it tighter than 600 s. 0 still disables.
-        watchdog_s and max(watchdog_s, 600),
-    )
-    # LocalTrainer's jitted step runs on exactly one device, so its
-    # examples/sec IS the per-chip figure regardless of how many chips the
-    # host exposes.
-    per_chip = resnet.get("examples_per_sec", 0.0)
-    baseline_img_per_sec = 145.0  # reference ResNet50/ImageNet, 1x P100
-    details = {
-        "resnet50": _round_if_ok(resnet),
-        "mobilenetv2": _round_if_ok(mobilenet),
-        "deepfm_criteo": _round_if_ok(deepfm),
-        "deepfm_ps_mode": deepfm_ps,
-        "elastic_rejoin": elastic,
-        "device_kind": jax.devices()[0].device_kind,
-        "n_devices": max(jax.local_device_count(), 1),
-    }
-    if "examples_per_sec" in deepfm:
-        details["deepfm_examples_per_sec_chip"] = round(
-            deepfm["examples_per_sec"], 2
-        )
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    "examples/sec/chip (ResNet50, bf16, 224x224, batch 128)"
-                ),
-                "value": round(per_chip, 2),
-                "unit": "examples/sec",
-                "vs_baseline": round(per_chip / baseline_img_per_sec, 3),
-                "details": details,
-            }
-        )
-    )
-    return 0
-
+from elasticdl_tpu.bench.__main__ import main
 
 if __name__ == "__main__":
-    import sys
-
     sys.exit(main())

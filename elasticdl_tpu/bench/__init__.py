@@ -1,0 +1,35 @@
+"""Performance evidence plane: the benchmark subsystem.
+
+What used to be a 575-line ``bench.py`` script is a package whose job is
+to make every performance claim in this repo *evidence*: measured in
+repeated timed windows, reported with bootstrap confidence intervals,
+attributed to phases (down to the serialize/wire/apply split inside
+``push_gradients``), bounded by a wall-clock budget that degrades step
+counts instead of dying, and gated against the last checked-in
+``BENCH_*.json`` so a ±2% drift is labeled "noise" vs "regression"
+instead of eyeballed.
+
+Layout (import cost matters — ``stats``, ``budget`` and ``gate`` are
+stdlib-only and never import jax, so the regression gate and the stats
+tests run in milliseconds):
+
+- ``stats``     bootstrap CIs, significance verdicts, BENCH_*.json
+                parsing/comparison. Pure stdlib.
+- ``budget``    BudgetClock + the per-benchmark watchdog (the BENCH_r05
+                rc=124 fix, now budget-aware). Pure stdlib.
+- ``gate``      the regression gate CLI (``make bench-gate``). Stdlib.
+- ``workloads`` the model benchmarks (ResNet50 / MobileNetV2 / DeepFM
+                dense + PS-mode). Imports jax — only loaded by the
+                runner.
+- ``matrix``    the PS-mode microbench matrix: wire codec x push
+                pipelining x PS shard count, each cell with a
+                serialize/wire/apply breakdown. Imports jax.
+- ``runner``    orchestrates a full or smoke run, always emits the one
+                JSON result line (even when truncated), attaches the
+                verdict vs the latest baseline, and keeps a flight
+                recorder armed so a killed run leaves evidence.
+
+CLI: ``python -m elasticdl_tpu.bench [--smoke] [--budget-s N] ...``;
+the repo-root ``bench.py`` is a thin shim onto it (the driver invokes
+``python bench.py``).
+"""

@@ -1,0 +1,86 @@
+"""CLI: ``python -m elasticdl_tpu.bench [--smoke] [...]``.
+
+The repo-root ``bench.py`` (what the driver invokes) is a thin shim
+onto this entrypoint; ``--gate`` forwards to the regression gate so
+one module answers both "measure" and "judge".
+"""
+
+import argparse
+import sys
+
+from elasticdl_tpu.common import knobs
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("bench")
+    parser.add_argument(
+        "--smoke",
+        action="store_true",
+        help="tiny shapes, CPU-safe, exits < 60 s (harness self-check)",
+    )
+    parser.add_argument(
+        "--watchdog_s", "--watchdog-s",
+        dest="watchdog_s",
+        type=float,
+        default=None,
+        help="per-benchmark wall-clock bound (default "
+        "ELASTICDL_BENCH_WATCHDOG_S, 50 with --smoke; 0 disables): one "
+        "wedged config cannot eat the run",
+    )
+    parser.add_argument(
+        "--budget-s",
+        type=float,
+        default=None,
+        help="soft total budget: workloads stop opening timed windows "
+        "when it runs out, degrading sample counts instead of dying "
+        "(default ELASTICDL_BENCH_BUDGET_S; 0 disables)",
+    )
+    parser.add_argument(
+        "--no-matrix",
+        action="store_true",
+        help="skip the PS microbench matrix in the full run",
+    )
+    parser.add_argument(
+        "--out",
+        default=None,
+        help="also write the result line to this file (atomic)",
+    )
+    parser.add_argument(
+        "--gate",
+        action="store_true",
+        help="run the regression gate instead of benchmarks "
+        "(see python -m elasticdl_tpu.bench.gate --help for options)",
+    )
+    args, rest = parser.parse_known_args(argv)
+
+    if args.gate:
+        from elasticdl_tpu.bench.gate import main as gate_main
+
+        return gate_main(rest)
+    if rest:
+        parser.error(f"unrecognized arguments: {' '.join(rest)}")
+
+    from elasticdl_tpu.bench import runner
+
+    if args.smoke:
+        return runner.run_smoke(
+            watchdog_s=(
+                args.watchdog_s if args.watchdog_s is not None else 50.0
+            ),
+            budget_s=args.budget_s,
+            out_path=args.out,
+        )
+    return runner.run_full(
+        watchdog_s=(
+            args.watchdog_s
+            if args.watchdog_s is not None
+            else knobs.get_float("ELASTICDL_BENCH_WATCHDOG_S")
+        ),
+        budget_s=args.budget_s,
+        with_matrix=not args.no_matrix,
+        out_path=args.out,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

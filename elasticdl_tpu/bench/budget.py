@@ -1,0 +1,87 @@
+"""Wall-clock budgeting for benchmark runs. Pure stdlib.
+
+Two layers of defense against the failure mode that produced BENCH_r05
+(rc=124: the whole run killed by an outer ``timeout``, zero evidence
+left behind):
+
+- ``BudgetClock``: a soft, cooperative budget. Workloads check
+  ``remaining()`` between timed windows and stop early — degrading the
+  sample count instead of dying — and the runner checks it between
+  benchmarks, skipping what no longer fits (each skip is recorded, so
+  truncation is visible in the JSON, never silent).
+- ``run_with_watchdog``: the hard per-benchmark bound (inherited from
+  the PR1 fix). The benchmark runs on a daemon thread; on timeout the
+  thread is abandoned — it can't be killed, but the run moves on, the
+  JSON line still gets emitted, and ``on_timeout`` (the flight-recorder
+  dump) fires so the wedged phase is named.
+"""
+
+import threading
+import time
+
+
+class BudgetClock:
+    """Counts down one shared wall-clock budget. ``total_s=0`` disables
+    the budget (remaining() is +inf, expired is never True)."""
+
+    def __init__(self, total_s=0.0):
+        self.total_s = float(total_s or 0.0)
+        self._start = time.perf_counter()
+
+    def elapsed(self):
+        return time.perf_counter() - self._start
+
+    def remaining(self):
+        if self.total_s <= 0:
+            return float("inf")
+        return self.total_s - self.elapsed()
+
+    @property
+    def expired(self):
+        return self.remaining() <= 0
+
+    def fits(self, estimate_s):
+        """Whether ``estimate_s`` more seconds of work fit the budget."""
+        return self.remaining() >= estimate_s
+
+
+def run_with_watchdog(name, fn, timeout_s, on_timeout=None):
+    """Run one benchmark with a hard wall-clock bound.
+
+    Returns fn()'s result, or {"error": ...} on exception, or
+    {"error": "...timeout", "timed_out": True} on timeout (after calling
+    ``on_timeout(name)``, best-effort). A wedged config must surface in
+    its own result slot, not eat the whole run's budget as an rc=124.
+    """
+    if not timeout_s:
+        try:
+            return fn()
+        except Exception as e:
+            return {"error": str(e)[:200]}
+
+    box = {}
+
+    def target():
+        try:
+            box["result"] = fn()
+        except Exception as e:
+            box["error"] = str(e)[:200]
+
+    thread = threading.Thread(
+        target=target, name=f"bench-{name}", daemon=True
+    )
+    thread.start()
+    thread.join(timeout_s)
+    if thread.is_alive():
+        if on_timeout is not None:
+            try:
+                on_timeout(name)
+            except Exception:
+                pass
+        return {
+            "error": f"watchdog timeout after {timeout_s:g}s",
+            "timed_out": True,
+        }
+    if "error" in box:
+        return {"error": box["error"]}
+    return box.get("result")

@@ -1,0 +1,141 @@
+"""The regression gate: ``make bench-gate``. Pure stdlib.
+
+Compares a candidate bench result against a baseline with
+``stats.compare_records`` and exits nonzero ONLY on a statistically
+significant practical regression (bootstrap CI of the relative
+median-difference excludes zero AND the effect clears the min-effect
+threshold). Noise, improvements, different-device runs
+("incomparable"), and missing evidence all pass — the gate exists to
+catch real slowdowns, not to punish running on different hardware or
+having too few samples to make a claim.
+
+Defaults: candidate = the newest parseable ``BENCH_r*.json`` in the
+repo root, baseline = the next newest (r05-style timeout wrappers with
+no JSON line in their tail parse to nothing and are skipped
+automatically). Both can be pointed anywhere — the tests feed synthetic
+pairs.
+"""
+
+import argparse
+import json
+import sys
+
+from elasticdl_tpu.bench import stats
+from elasticdl_tpu.common import knobs
+
+
+def run_gate(baseline_path=None, candidate_path=None, min_effect=None,
+             root=None, out=sys.stdout):
+    """Returns the process exit code (0 pass, 1 regression, 2 usage)."""
+    if root is None:
+        from elasticdl_tpu.bench.runner import REPO_ROOT as root
+    if min_effect is None:
+        min_effect = knobs.get_float("ELASTICDL_BENCH_MIN_EFFECT")
+
+    if candidate_path:
+        candidate = stats.load_bench_file(candidate_path)
+        if candidate is None:
+            print(
+                f"bench-gate: candidate {candidate_path} has no "
+                "parseable bench record", file=out,
+            )
+            return 2
+    else:
+        pairs = stats.find_baselines(root)
+        if not pairs:
+            print(
+                "bench-gate: PASS (no parseable BENCH_*.json to gate)",
+                file=out,
+            )
+            return 0
+        candidate_path, candidate = pairs[0]
+
+    if baseline_path:
+        baseline = stats.load_bench_file(baseline_path)
+        if baseline is None:
+            print(
+                f"bench-gate: baseline {baseline_path} has no "
+                "parseable bench record", file=out,
+            )
+            return 2
+    else:
+        pairs = stats.find_baselines(root, exclude=candidate_path)
+        if not pairs:
+            print(
+                "bench-gate: PASS (no baseline to compare "
+                f"{candidate_path} against)", file=out,
+            )
+            return 0
+        baseline_path, baseline = stats.select_baseline(
+            pairs, stats.device_kind(candidate)
+        )
+
+    verdict = stats.compare_records(
+        baseline, candidate, min_effect=min_effect
+    )
+    overall = verdict["overall"]
+    print(
+        f"bench-gate: {candidate_path} vs {baseline_path} "
+        f"(min effect {min_effect:.1%})", file=out,
+    )
+    for name, v in sorted(verdict["metrics"].items()):
+        effect = v.get("effect")
+        ci = v.get("effect_ci")
+        line = f"  {name}: {v['verdict']}"
+        if effect is not None:
+            line += f" (effect {effect:+.1%}"
+            if ci:
+                line += f", 95% CI [{ci[0]:+.1%}, {ci[1]:+.1%}]"
+            line += f", n={v['n_base']}v{v['n_cand']})"
+        print(line, file=out)
+    if overall == stats.VERDICT_INCOMPARABLE:
+        d = verdict["device"]
+        print(
+            "bench-gate: PASS (incomparable — baseline ran on "
+            f"{d['baseline']!r}, candidate on {d['candidate']!r})",
+            file=out,
+        )
+        return 0
+    if overall == stats.VERDICT_REGRESSION:
+        print("bench-gate: FAIL (significant regression)", file=out)
+        print(json.dumps(verdict), file=out)
+        return 1
+    print(f"bench-gate: PASS ({overall})", file=out)
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        "bench-gate",
+        description="fail on statistically significant bench regressions",
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help="baseline BENCH json (default: next-newest parseable "
+        "BENCH_r*.json)",
+    )
+    parser.add_argument(
+        "--candidate", default=None,
+        help="candidate BENCH json (default: newest parseable "
+        "BENCH_r*.json)",
+    )
+    parser.add_argument(
+        "--min-effect", type=float, default=None,
+        help="relative effect below which a significant difference is "
+        "still noise (default: ELASTICDL_BENCH_MIN_EFFECT)",
+    )
+    parser.add_argument(
+        "--root", default=None,
+        help="directory to search for BENCH_r*.json (default: repo root)",
+    )
+    args = parser.parse_args(argv)
+    return run_gate(
+        baseline_path=args.baseline,
+        candidate_path=args.candidate,
+        min_effect=args.min_effect,
+        root=args.root,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

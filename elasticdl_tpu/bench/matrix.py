@@ -1,0 +1,218 @@
+"""PS-mode microbench matrix: wire codec x push pipelining x shard count.
+
+The denominator for the quantized-transport work: BENCH_r04 showed
+``push_gradients`` eating ~79% of the PS-mode DeepFM step, but one
+number for the whole push can't say whether a codec change helped the
+serialize leg, the wire leg, or the PS-side apply. Every cell here
+reports examples/s (median over repeats, bootstrap CI when enough
+windows fit the budget) AND the push decomposed into
+serialize / wire / apply sub-spans:
+
+- serialize: worker-side host work — device_get + dedup + proto build
+  (``push_serialize`` in the trainer's Timing, recorded by PSClient);
+- apply:     PS-side optimizer apply, reported back per push on
+  ``PushGradientsResponse.apply_seconds`` (max over shards — shards
+  apply concurrently, so the slowest shard gates the RPC);
+- wire:      the remainder of the RPC wait after subtracting the
+  reported apply — TCP + proto decode on both ends.
+
+Cells run the same hot loop as the headline ``deepfm_ps`` bench (real
+localhost gRPC shards, native id-map kernels), so a matrix cell and the
+headline number are directly comparable.
+"""
+
+import time
+
+import numpy as np
+
+from elasticdl_tpu.bench import stats
+from elasticdl_tpu.observability import flightrec
+
+DEFAULT_SHARD_COUNTS = (1, 2)
+DEFAULT_CODECS = ("float32", "bfloat16")
+DEFAULT_PIPELINING = (False, True)
+
+# Sub-phases PSClient records inside push_gradients (see worker/
+# ps_client.py); the matrix folds them into each cell's breakdown.
+PUSH_SUBPHASES = ("push_serialize", "push_wire", "push_apply")
+
+
+def make_batches(batch_size, n_batches=4, seed=0):
+    """Distinct id sets so embedding pulls stay realistic run to run."""
+    from elasticdl_tpu.models.dac_ctr.transform import (
+        NUM_FIELDS,
+        TOTAL_IDS,
+    )
+
+    rng = np.random.default_rng(seed)
+    batches = []
+    for _ in range(n_batches):
+        features = {
+            "dense": rng.normal(size=(batch_size, 13)).astype(np.float32),
+            "ids": rng.integers(
+                0, TOTAL_IDS, size=(batch_size, NUM_FIELDS)
+            ).astype(np.int32),
+        }
+        labels = rng.integers(0, 2, batch_size).astype(np.int64)
+        batches.append((features, labels))
+    return batches
+
+
+def run_ps_config(batches, steps, warmup, num_ps, pipelined, wire_dtype):
+    """One timed run of the PS hot loop under one matrix cell's config.
+
+    Returns {"examples_per_sec", "step_time_ms", "phase_mean_ms",
+    "push_breakdown_ms"}. warmup should cover every distinct batch once
+    (cold-row lazy init inside the timed window was the old r4 spread).
+    """
+    from elasticdl_tpu.common.model_utils import get_model_spec
+    from elasticdl_tpu.ps.parameter_server import ParameterServer
+    from elasticdl_tpu.worker.ps_client import PSClient
+    from elasticdl_tpu.worker.ps_trainer import ParameterServerTrainer
+
+    spec = get_model_spec("elasticdl_tpu.models.dac_ctr.deepfm_ps")
+    batch_size = len(batches[0][1])
+    servers = [
+        ParameterServer(
+            i, num_ps, optimizer_spec=spec.build_optimizer_spec()
+        )
+        for i in range(num_ps)
+    ]
+    client = None
+    trainer = None
+    try:
+        client = PSClient(
+            [s.addr for s in servers], worker_id=0, wire_dtype=wire_dtype
+        )
+        trainer = ParameterServerTrainer(
+            spec.build_model(),
+            spec.loss,
+            spec.build_optimizer_spec(),
+            client,
+            embedding_inputs=spec.module.embedding_inputs,
+            pipeline_pushes=pipelined,
+        )
+        n_batches = len(batches)
+        for i in range(warmup):
+            f, l = batches[i % n_batches]
+            trainer.train_minibatch(f, l)
+        trainer._flush_pushes()
+        trainer.timing.reset()
+        start = time.perf_counter()
+        loss = None
+        for i in range(steps):
+            f, l = batches[i % n_batches]
+            _, _, loss = trainer.train_minibatch(f, l)
+        float(loss)
+        trainer._flush_pushes()
+        elapsed = time.perf_counter() - start
+        phases = {
+            phase: round(s["mean_s"] * 1e3, 2)
+            for phase, s in trainer.timing.summary().items()
+        }
+        breakdown = {
+            p[len("push_"):]: phases[p]
+            for p in PUSH_SUBPHASES
+            if p in phases
+        }
+        return {
+            "examples_per_sec": batch_size * steps / elapsed,
+            "step_time_ms": elapsed / steps * 1e3,
+            "phase_mean_ms": phases,
+            "push_breakdown_ms": breakdown,
+        }
+    finally:
+        if trainer is not None:
+            trainer.close()
+        if client is not None:
+            client.close()
+        for s in servers:
+            s.stop()
+
+
+def cell_name(num_ps, pipelined, wire_dtype):
+    codec = "bf16" if wire_dtype == "bfloat16" else "f32"
+    return f"ps{num_ps}-{'overlapped' if pipelined else 'serial'}-{codec}"
+
+
+def bench_ps_matrix(batch_size=16384, steps=6, warmup=4, repeats=3,
+                    shard_counts=DEFAULT_SHARD_COUNTS,
+                    codecs=DEFAULT_CODECS,
+                    pipelining=DEFAULT_PIPELINING,
+                    clock=None, seed=0):
+    """The full matrix. Budget-aware at two grains: a cell that no
+    longer fits is skipped (recorded as {"skipped": "budget"}), and a
+    cell mid-repeats stops early with the samples it has (marked
+    truncated). The cells that did run always report."""
+    batches = make_batches(batch_size, seed=seed)
+    cells = {}
+    cell_cost_s = None
+    for num_ps in shard_counts:
+        for pipelined in pipelining:
+            for wire_dtype in codecs:
+                name = cell_name(num_ps, pipelined, wire_dtype)
+                if clock is not None and (
+                    clock.expired
+                    or (cell_cost_s and not clock.fits(cell_cost_s))
+                ):
+                    cells[name] = {"skipped": "budget"}
+                    continue
+                cell_start = time.perf_counter()
+                with flightrec.phase(f"ps_matrix:{name}"):
+                    cells[name] = _run_cell(
+                        batches, steps, warmup, num_ps, pipelined,
+                        wire_dtype, repeats, clock,
+                    )
+                # One completed cell calibrates the skip estimate for
+                # the rest (cells are roughly the same size).
+                cell_cost_s = time.perf_counter() - cell_start
+    return {
+        "axes": {
+            "shards": list(shard_counts),
+            "pipelining": [
+                "overlapped" if p else "serial" for p in pipelining
+            ],
+            "codec": list(codecs),
+        },
+        "batch_size": batch_size,
+        "steps_per_run": steps,
+        "repeats": repeats,
+        "cells": cells,
+    }
+
+
+def _run_cell(batches, steps, warmup, num_ps, pipelined, wire_dtype,
+              repeats, clock):
+    runs = []
+    truncated = False
+    for i in range(repeats):
+        if i > 0 and clock is not None and clock.expired:
+            truncated = True
+            break
+        runs.append(
+            run_ps_config(
+                batches, steps, warmup, num_ps, pipelined, wire_dtype
+            )
+        )
+    samples = [r["examples_per_sec"] for r in runs]
+    summary = stats.summarize(samples)
+    # The reported phase breakdown is the run closest to the median so
+    # phases and headline describe the same execution.
+    rep, _ = stats.representative_run(runs)
+    out = {
+        "examples_per_sec": summary["median"],
+        "samples": [round(s, 1) for s in samples],
+        "step_time_ms": rep["step_time_ms"],
+        "phase_mean_ms": rep["phase_mean_ms"],
+        "push_breakdown_ms": rep["push_breakdown_ms"],
+    }
+    if "ci95" in summary:
+        out["examples_per_sec_ci95"] = [
+            round(summary["ci95"][0], 1),
+            round(summary["ci95"][1], 1),
+        ]
+    if "spread" in summary:
+        out["run_spread"] = round(summary["spread"], 3)
+    if truncated:
+        out["truncated"] = True
+    return out

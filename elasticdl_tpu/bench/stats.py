@@ -1,0 +1,343 @@
+"""Bench statistics: bootstrap CIs, significance verdicts, baseline IO.
+
+Pure stdlib (no jax, no numpy) so the regression gate and the stats
+tests run in milliseconds, and so a broken accelerator stack can never
+take the *evidence* machinery down with it.
+
+The estimator of record is the MEDIAN: every timed window shares one
+host with the PS shards and the codec threads, so the sample
+distribution is right-skewed by load spikes and the median is the
+robust center (the same reasoning as bench.py's old median-of-n
+reporting, now with an interval around it).
+
+Verdicts compare two sample sets with a bootstrap CI on the *relative*
+difference of medians: "regression"/"improvement" only when the CI
+excludes zero AND the median effect clears ``min_effect`` (so a
+statistically-real-but-tiny drift is still "noise"), "insufficient"
+when either side has too few samples to resample meaningfully.
+All resampling is seeded — the same inputs always produce the same
+verdict.
+"""
+
+import glob
+import json
+import math
+import os
+import random
+import re
+import statistics
+
+# Below this many samples a bootstrap over windows is theater: 2 samples
+# have 2^2=4 distinct resamples. Point estimates are still reported.
+MIN_SAMPLES_FOR_CI = 3
+
+DEFAULT_BOOTSTRAP_N = 2000
+DEFAULT_ALPHA = 0.05
+# Relative effect below which a statistically significant difference is
+# still reported as noise: the r02->r04 ResNet numbers drift ~±2% run to
+# run on identical code, so a gate tighter than that would cry wolf.
+DEFAULT_MIN_EFFECT = 0.02
+
+VERDICT_REGRESSION = "regression"
+VERDICT_IMPROVEMENT = "improvement"
+VERDICT_NOISE = "noise"
+VERDICT_INSUFFICIENT = "insufficient-data"
+VERDICT_INCOMPARABLE = "incomparable"
+
+
+def bootstrap_ci(samples, n_boot=DEFAULT_BOOTSTRAP_N, alpha=DEFAULT_ALPHA,
+                 seed=0, stat=statistics.median):
+    """Percentile-bootstrap CI for ``stat`` over ``samples``.
+
+    Returns (lo, hi), or None when the sample count is below
+    MIN_SAMPLES_FOR_CI (an interval from 2 points would look like
+    evidence without being any).
+    """
+    samples = [float(s) for s in samples]
+    if len(samples) < MIN_SAMPLES_FOR_CI:
+        return None
+    rng = random.Random(seed)
+    n = len(samples)
+    stats_ = sorted(
+        stat([samples[rng.randrange(n)] for _ in range(n)])
+        for _ in range(n_boot)
+    )
+    lo = stats_[int(math.floor((alpha / 2) * (n_boot - 1)))]
+    hi = stats_[int(math.ceil((1 - alpha / 2) * (n_boot - 1)))]
+    return lo, hi
+
+
+def summarize(samples, seed=0):
+    """{"median", "mean", "n", "ci95" | None, "spread"} for a sample set.
+
+    ``spread`` is max/min (the old bench spread gate's statistic);
+    ``ci95`` is the bootstrap interval around the median.
+    """
+    samples = [float(s) for s in samples]
+    if not samples:
+        return {"n": 0}
+    out = {
+        "median": statistics.median(samples),
+        "mean": statistics.fmean(samples),
+        "n": len(samples),
+        "spread": max(samples) / max(min(samples), 1e-9),
+    }
+    ci = bootstrap_ci(samples, seed=seed)
+    if ci is not None:
+        out["ci95"] = [ci[0], ci[1]]
+    return out
+
+
+def representative_run(runs, key="examples_per_sec"):
+    """(run closest to the median of ``key``, the median). The headline
+    of a repeated benchmark is the MEDIAN (never the max — a collapsed
+    outlier run must drag the spread flag, not vanish), and the phase
+    breakdown reported next to it must come from the run nearest that
+    median so phases and headline describe the same execution."""
+    values = [float(r[key]) for r in runs]
+    med = statistics.median(values)
+    rep = min(runs, key=lambda r: abs(float(r[key]) - med))
+    return rep, med
+
+
+def significance_verdict(baseline_samples, candidate_samples,
+                         min_effect=DEFAULT_MIN_EFFECT,
+                         n_boot=DEFAULT_BOOTSTRAP_N, alpha=DEFAULT_ALPHA,
+                         seed=0):
+    """Compare candidate vs baseline samples of a higher-is-better metric.
+
+    Returns {"verdict", "effect", "effect_ci" | None, "n_base", "n_cand"}.
+    ``effect`` is the relative difference of medians
+    (cand - base) / base; negative means the candidate is slower.
+
+    The verdict is "regression"/"improvement" only when BOTH hold:
+    the bootstrap CI of the effect excludes zero (statistically real)
+    and |median effect| >= min_effect (practically real). With too few
+    samples on either side to bootstrap, the verdict is
+    "insufficient-data" — the point effect is still reported so a
+    truncated run leaves a number, just not a claim.
+    """
+    base = [float(s) for s in baseline_samples]
+    cand = [float(s) for s in candidate_samples]
+    out = {"n_base": len(base), "n_cand": len(cand)}
+    if not base or not cand:
+        out["verdict"] = VERDICT_INSUFFICIENT
+        return out
+    base_med = statistics.median(base)
+    cand_med = statistics.median(cand)
+    if base_med <= 0:
+        out["verdict"] = VERDICT_INSUFFICIENT
+        return out
+    effect = (cand_med - base_med) / base_med
+    out["effect"] = effect
+    if (len(base) < MIN_SAMPLES_FOR_CI
+            or len(cand) < MIN_SAMPLES_FOR_CI):
+        out["verdict"] = VERDICT_INSUFFICIENT
+        return out
+    rng = random.Random(seed)
+    nb, nc = len(base), len(cand)
+    effects = sorted(
+        (
+            statistics.median(
+                [cand[rng.randrange(nc)] for _ in range(nc)]
+            )
+            - (
+                bm := statistics.median(
+                    [base[rng.randrange(nb)] for _ in range(nb)]
+                )
+            )
+        )
+        / max(bm, 1e-12)
+        for _ in range(n_boot)
+    )
+    lo = effects[int(math.floor((alpha / 2) * (n_boot - 1)))]
+    hi = effects[int(math.ceil((1 - alpha / 2) * (n_boot - 1)))]
+    out["effect_ci"] = [lo, hi]
+    significant = lo > 0 or hi < 0
+    if significant and effect <= -min_effect:
+        out["verdict"] = VERDICT_REGRESSION
+    elif significant and effect >= min_effect:
+        out["verdict"] = VERDICT_IMPROVEMENT
+    else:
+        out["verdict"] = VERDICT_NOISE
+    return out
+
+
+# ---------------------------------------------------------------------------
+# BENCH_*.json parsing. Two shapes exist on disk:
+#  - the driver wrapper {"n": .., "cmd": .., "rc": .., "tail": "...log..."}
+#    whose tail *contains* the bench JSON line somewhere (r05's tail does
+#    not — it timed out before emitting; that file parses to None);
+#  - a raw bench result line {"metric", "value", "unit", "details", ...}
+#    (what the runner itself writes).
+# ---------------------------------------------------------------------------
+
+
+def extract_bench_record(obj):
+    """The bench result dict from either on-disk shape, or None."""
+    if not isinstance(obj, dict):
+        return None
+    if "metric" in obj and "details" in obj:
+        return obj
+    tail = obj.get("tail")
+    if not isinstance(tail, str):
+        return None
+    # Last parseable JSON object line wins (logs precede the result).
+    for line in reversed(tail.splitlines()):
+        line = line.strip()
+        if not (line.startswith("{") and '"metric"' in line):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "details" in rec:
+            return rec
+    # The driver may have truncated the tail mid-line; try from the last
+    # '{"metric"' to the end.
+    m = tail.rfind('{"metric"')
+    if m >= 0:
+        try:
+            rec = json.loads(tail[m:])
+            if isinstance(rec, dict) and "details" in rec:
+                return rec
+        except ValueError:
+            pass
+    return None
+
+
+def load_bench_file(path):
+    """Parse one BENCH_*.json from disk -> bench record dict or None."""
+    try:
+        with open(path) as f:
+            return extract_bench_record(json.load(f))
+    except (OSError, ValueError):
+        return None
+
+
+def find_baselines(root, exclude=None):
+    """BENCH_r*.json files under ``root`` that parse to a usable record,
+    newest round first. ``exclude`` drops one path (the candidate)."""
+    out = []
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        if exclude and os.path.abspath(path) == os.path.abspath(exclude):
+            continue
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        rec = load_bench_file(path)
+        if rec is not None:
+            out.append((int(m.group(1)), path, rec))
+    out.sort(reverse=True)
+    return [(path, rec) for _, path, rec in out]
+
+
+def _walk_metrics(details, prefix, out):
+    for key, value in details.items():
+        name = f"{prefix}{key}" if not prefix else f"{prefix}.{key}"
+        if isinstance(value, dict):
+            _walk_metrics(value, name, out)
+        elif key in ("examples_per_sec", "samples") and isinstance(
+            value, (int, float, list)
+        ):
+            out[name] = value
+
+
+def comparable_metrics(record):
+    """Flatten a bench record into {metric_path: samples_list}.
+
+    Every ``examples_per_sec`` found anywhere in ``details`` becomes a
+    comparable metric; its samples are (in preference order) the sibling
+    ``samples`` list, the legacy ``runs_examples_per_sec`` list, or the
+    point value as a 1-sample list. Higher is better for all of them.
+    """
+    details = record.get("details") or {}
+    flat = {}
+    _walk_metrics(details, "", flat)
+    out = {}
+    for name, value in flat.items():
+        if not name.endswith(".examples_per_sec") and name != (
+            "examples_per_sec"
+        ):
+            continue
+        base = name[: -len("examples_per_sec")]
+        parent = _dig(details, base.rstrip(".").split(".")) if base else (
+            details
+        )
+        samples = None
+        if isinstance(parent, dict):
+            samples = parent.get("samples") or parent.get(
+                "runs_examples_per_sec"
+            )
+        if not isinstance(samples, list) or not samples:
+            samples = [value] if isinstance(value, (int, float)) else None
+        if samples:
+            out[base.rstrip(".") or "headline"] = [
+                float(s) for s in samples
+            ]
+    return out
+
+
+def _dig(d, path):
+    for p in path:
+        if not isinstance(d, dict):
+            return None
+        d = d.get(p)
+    return d
+
+
+def device_kind(record):
+    details = record.get("details") or {}
+    return details.get("device_kind") or ""
+
+
+def select_baseline(pairs, candidate_device):
+    """Pick the baseline to compare a candidate against: the NEWEST
+    round with a MATCHING device_kind, falling back to the newest
+    overall (which yields an honest "incomparable"). Without the device
+    preference, one checked-in CPU round would make every later TPU run
+    compare against it, auto-pass as incomparable, and silently disable
+    regression detection until someone commits a same-device round."""
+    if candidate_device:
+        for path, rec in pairs:
+            if device_kind(rec) == candidate_device:
+                return path, rec
+    return pairs[0] if pairs else (None, None)
+
+
+def compare_records(baseline, candidate, min_effect=DEFAULT_MIN_EFFECT,
+                    seed=0):
+    """Per-metric verdicts of candidate vs baseline bench records.
+
+    Returns {"overall": verdict, "device": {...}, "metrics": {name:
+    verdict-dict}}. When the two records ran on different device kinds
+    every throughput comparison is apples-to-oranges: the overall
+    verdict is "incomparable" and no per-metric claim is made.
+    """
+    base_kind, cand_kind = device_kind(baseline), device_kind(candidate)
+    out = {
+        "device": {"baseline": base_kind, "candidate": cand_kind},
+        "metrics": {},
+    }
+    if base_kind != cand_kind:
+        out["overall"] = VERDICT_INCOMPARABLE
+        return out
+    base_metrics = comparable_metrics(baseline)
+    cand_metrics = comparable_metrics(candidate)
+    worst = VERDICT_INSUFFICIENT
+    rank = {
+        VERDICT_INSUFFICIENT: 0,
+        VERDICT_IMPROVEMENT: 1,
+        VERDICT_NOISE: 2,
+        VERDICT_REGRESSION: 3,
+    }
+    for name in sorted(set(base_metrics) & set(cand_metrics)):
+        verdict = significance_verdict(
+            base_metrics[name], cand_metrics[name],
+            min_effect=min_effect, seed=seed,
+        )
+        out["metrics"][name] = verdict
+        if rank[verdict["verdict"]] > rank[worst]:
+            worst = verdict["verdict"]
+    out["overall"] = worst if out["metrics"] else VERDICT_INSUFFICIENT
+    return out

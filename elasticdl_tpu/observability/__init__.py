@@ -42,13 +42,15 @@ _handle = None
 class ObservabilityHandle:
     """One process's configured observability plane."""
 
-    def __init__(self, role, job, obs_dir, exporter, recorder, event_log):
+    def __init__(self, role, job, obs_dir, exporter, recorder, event_log,
+                 flight=None):
         self.role = role
         self.job = job
         self.obs_dir = obs_dir
         self.exporter = exporter
         self.recorder = recorder
         self.event_log = event_log
+        self.flight = flight
 
     @property
     def metrics_port(self):
@@ -56,6 +58,11 @@ class ObservabilityHandle:
 
     def close(self):
         global _handle
+        if self.flight is not None:
+            from elasticdl_tpu.observability import flightrec
+
+            if flightrec.get() is self.flight:
+                flightrec.uninstall()
         if self.exporter is not None:
             self.exporter.close()
         if self.recorder is not None:
@@ -102,6 +109,7 @@ def setup(role, job="", obs_dir=None, metrics_port=None, registry=None):
 
     recorder = None
     event_log = None
+    flight = None
     if obs_dir:
         os.makedirs(obs_dir, exist_ok=True)
         recorder = _tracing.SpanRecorder(
@@ -113,6 +121,14 @@ def setup(role, job="", obs_dir=None, metrics_port=None, registry=None):
             os.path.join(obs_dir, "events.jsonl"), job=job, role=role
         )
         _events.set_event_log(event_log)
+        # Crash-dump flight recorder: a bounded ring of the spans the
+        # plane just started emitting, dumped to
+        # <obs_dir>/flightrec-<role>.json on crash/SIGTERM so a dead
+        # role leaves attributable evidence (ELASTICDL_FLIGHTREC=0
+        # disables).
+        from elasticdl_tpu.observability import flightrec
+
+        flight = flightrec.install(role, dump_dir=obs_dir)
 
     exporter = None
     if metrics_port >= 0:
@@ -131,7 +147,7 @@ def setup(role, job="", obs_dir=None, metrics_port=None, registry=None):
         _advertise_endpoint(obs_dir, role, job, exporter.port)
 
     _handle = ObservabilityHandle(
-        role, job, obs_dir, exporter, recorder, event_log
+        role, job, obs_dir, exporter, recorder, event_log, flight
     )
     return _handle
 

@@ -38,6 +38,29 @@ _context = contextvars.ContextVar("edl_trace_context", default=None)
 
 _recorder = None
 
+# Secondary span consumers (the flight recorder). Sinks receive every
+# span the plane observes — (name, start_s, dur_s, cat, args) — even
+# when no file recorder is installed, and must be cheap + non-raising.
+_sinks = []
+
+
+def add_sink(sink):
+    if sink not in _sinks:
+        _sinks.append(sink)
+
+
+def remove_sink(sink):
+    if sink in _sinks:
+        _sinks.remove(sink)
+
+
+def _feed_sinks(name, start_s, dur_s, cat, args):
+    for sink in list(_sinks):
+        try:
+            sink(name, start_s, dur_s, cat, args)
+        except Exception:
+            pass
+
 
 class TraceContext:
     __slots__ = ("trace_id", "span_id", "job", "task_id", "lease_epoch")
@@ -183,17 +206,21 @@ def get_recorder():
 
 @contextlib.contextmanager
 def span(name, cat="edl", **args):
-    """Record a span around the with-body (no-op without a recorder; the
-    body's exceptions still propagate and the span still closes)."""
+    """Record a span around the with-body (no-op without a recorder or
+    sink; the body's exceptions still propagate and the span still
+    closes)."""
     rec = _recorder
-    if rec is None:
+    if rec is None and not _sinks:
         yield
         return
     start = time.time()
     try:
         yield
     finally:
-        rec.record(name, start, time.time() - start, cat=cat, args=args)
+        dur = time.time() - start
+        if rec is not None:
+            rec.record(name, start, dur, cat=cat, args=args)
+        _feed_sinks(name, start, dur, cat, args)
 
 
 def instant(name, cat="edl", **args):
@@ -254,21 +281,26 @@ class TracingClientInterceptor(grpc.UnaryUnaryClientInterceptor):
             details, _inject(details.metadata)
         )
         rec = _recorder
-        if rec is None:
+        if rec is None and not _sinks:
             return continuation(new_details, request)
         start = time.time()
         call = continuation(new_details, request)
+
         # Record at response time so the span covers the full RPC. Futures
         # returned by stub.method.future() are recorded when they resolve.
-        call.add_done_callback(
-            lambda c, s=start: rec.record(
-                f"rpc_client{details.method}",
-                s,
-                time.time() - s,
-                cat="rpc",
-                args={"code": str(c.code())},
+        def done(c, s=start):
+            dur = time.time() - s
+            args = {"code": str(c.code())}
+            if rec is not None:
+                rec.record(
+                    f"rpc_client{details.method}", s, dur, cat="rpc",
+                    args=args,
+                )
+            _feed_sinks(
+                f"rpc_client{details.method}", s, dur, "rpc", args
             )
-        )
+
+        call.add_done_callback(done)
         return call
 
 
@@ -292,17 +324,19 @@ class TracingServerInterceptor(grpc.ServerInterceptor):
                 token = _context.set(ctx)
             try:
                 rec = _recorder
-                if rec is None:
+                if rec is None and not _sinks:
                     return inner(request, context)
                 start = time.time()
                 try:
                     return inner(request, context)
                 finally:
-                    rec.record(
-                        f"rpc_server{method}",
-                        start,
-                        time.time() - start,
-                        cat="rpc",
+                    dur = time.time() - start
+                    if rec is not None:
+                        rec.record(
+                            f"rpc_server{method}", start, dur, cat="rpc"
+                        )
+                    _feed_sinks(
+                        f"rpc_server{method}", start, dur, "rpc", None
                     )
             finally:
                 if token is not None:

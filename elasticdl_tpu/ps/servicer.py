@@ -216,14 +216,19 @@ class PserverServicer:
             start = time.perf_counter()
             with tracing.span("ps_apply_async"):
                 self._apply_model_pb(request.gradients)
-            _APPLY_SECONDS.observe(time.perf_counter() - start)
+            apply_seconds = time.perf_counter() - start
+            _APPLY_SECONDS.observe(apply_seconds)
             self._params.total_records += request.batch_size
             self._params.version += 1
             version = self._params.version
             snapshot = self._snapshot_if_due(version)
         _PS_VERSION.set(version)
         self._post_apply(version, snapshot)
-        return pb.PushGradientsResponse(accepted=True, version=version)
+        # apply_seconds lets the pushing worker split its RPC wait into
+        # wire vs apply time (the microbench matrix's breakdown).
+        return pb.PushGradientsResponse(
+            accepted=True, version=version, apply_seconds=apply_seconds
+        )
 
     # ---------- sync path ----------
 
@@ -297,7 +302,8 @@ class PserverServicer:
                     )
             finally:
                 self._opt.end_apply()
-            _APPLY_SECONDS.observe(time.perf_counter() - apply_start)
+            apply_seconds = time.perf_counter() - apply_start
+            _APPLY_SECONDS.observe(apply_seconds)
             self._grad_sum.clear()
             self._sparse_acc.clear()
             self._grad_n = 0
@@ -308,7 +314,11 @@ class PserverServicer:
             snapshot = self._snapshot_if_due(version)
         _PS_VERSION.set(version)
         self._post_apply(version, snapshot)
-        return pb.PushGradientsResponse(accepted=True, version=version)
+        # Only the quorum-completing push reports the apply cost (the
+        # buffered ones above return without applying anything).
+        return pb.PushGradientsResponse(
+            accepted=True, version=version, apply_seconds=apply_seconds
+        )
 
     # ---------- shared ----------
 

@@ -8,12 +8,14 @@ in parallel; sparse grads are merged/deduplicated *before* the wire
 (ps_client.py:135-232).
 """
 
+import time
+
 import grpc
 import numpy as np
 
 from elasticdl_tpu.common import hash_utils, rpc, tensor_utils
 from elasticdl_tpu.common.log_utils import get_logger
-from elasticdl_tpu.observability import emit_event
+from elasticdl_tpu.observability import emit_event, tracing
 from elasticdl_tpu.observability.metrics import default_registry
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
@@ -45,6 +47,11 @@ class PSClient:
         # the wire dtype (bf16 rows/grads stay bf16 across the
         # host<->device hop too).
         self.bf16_wire = wire_dtype == "bfloat16"
+        # Optional common.timing.Timing: when bound (the PS trainer binds
+        # its own), push_gradients records its serialize/wire/apply
+        # sub-phases there — the decomposition the microbench matrix and
+        # a flagged BENCH run need to attribute the dominant phase.
+        self.timing = None
         self._addrs = list(ps_addrs)
         self._worker_id = worker_id
         # Readiness-probe all shards CONCURRENTLY, then build channels
@@ -374,81 +381,102 @@ class PSClient:
         {table_name: (values [k, dim], ids [k])} — deduplicated here before
         partitioning. batch_size = records in the minibatch behind this
         push (feeds the checkpoint's exact consumed-record counter).
-        Returns (accepted_all, max_version)."""
-        dense_parts = self.partition_dense_names(dense_grads)
-        shard_models = {}
+        Returns (accepted_all, max_version).
 
-        def model_for(ps_id):
-            if ps_id not in shard_models:
-                shard_models[ps_id] = pb.Model(version=version)
-            return shard_models[ps_id]
+        Sub-span attribution (when ``self.timing`` is bound): the push
+        splits into push_serialize (host-side dedup + proto build),
+        push_apply (the slowest shard's optimizer apply, reported back
+        on PushGradientsResponse.apply_seconds — shards apply
+        concurrently, so the max is what gated the wait), and push_wire
+        (the remaining RPC wait: TCP + proto decode on both ends)."""
+        serialize_start = time.perf_counter()
+        with tracing.span("ps_push_serialize"):
+            dense_parts = self.partition_dense_names(dense_grads)
+            shard_models = {}
 
-        for ps_id, names in dense_parts.items():
-            m = model_for(ps_id)
-            for name in names:
-                m.dense_parameters.append(
-                    tensor_utils.ndarray_to_tensor_pb(
-                        np.ascontiguousarray(
-                            dense_grads[name], dtype=np.float32
-                        ),
-                        name,
-                    )
-                )
-        for table, (values, ids) in sparse_grads.items():
-            values, ids = tensor_utils.deduplicate_indexed_slices(
-                np.asarray(values, dtype=np.float32),
-                np.asarray(ids, dtype=np.int64),
-            )
-            if self.bf16_wire:
-                values = values.astype(tensor_utils.bfloat16)
-            for ps_id, (shard_ids, positions) in (
-                hash_utils.scatter_embedding_ids(ids, self.num_ps).items()
-            ):
+            def model_for(ps_id):
+                if ps_id not in shard_models:
+                    shard_models[ps_id] = pb.Model(version=version)
+                return shard_models[ps_id]
+
+            for ps_id, names in dense_parts.items():
                 m = model_for(ps_id)
-                m.embedding_tables[table].CopyFrom(
-                    tensor_utils.ndarray_to_indexed_slices_pb(
-                        np.ascontiguousarray(values[positions]),
-                        shard_ids,
-                        table,
+                for name in names:
+                    m.dense_parameters.append(
+                        tensor_utils.ndarray_to_tensor_pb(
+                            np.ascontiguousarray(
+                                dense_grads[name], dtype=np.float32
+                            ),
+                            name,
+                        )
                     )
+            for table, (values, ids) in sparse_grads.items():
+                values, ids = tensor_utils.deduplicate_indexed_slices(
+                    np.asarray(values, dtype=np.float32),
+                    np.asarray(ids, dtype=np.int64),
                 )
-        futures = [
-            (
-                ps_id,
-                self._stubs[ps_id].push_gradients.future(
-                    pb.PushGradientsRequest(
-                        gradients=m,
-                        learning_rate=learning_rate,
-                        worker_id_plus_one=(
-                            self._worker_id + 1
-                            if self._worker_id >= 0
-                            else 0
-                        ),
-                        batch_size=batch_size,
+                if self.bf16_wire:
+                    values = values.astype(tensor_utils.bfloat16)
+                for ps_id, (shard_ids, positions) in (
+                    hash_utils.scatter_embedding_ids(
+                        ids, self.num_ps
+                    ).items()
+                ):
+                    m = model_for(ps_id)
+                    m.embedding_tables[table].CopyFrom(
+                        tensor_utils.ndarray_to_indexed_slices_pb(
+                            np.ascontiguousarray(values[positions]),
+                            shard_ids,
+                            table,
+                        )
                     )
-                ),
-            )
-            for ps_id, m in shard_models.items()
-        ]
-        accepted, max_version = True, 0
-        delivered, last_err = 0, None
-        for ps_id, f in futures:
-            try:
-                res = f.result()
-            except grpc.RpcError as e:
-                # Degraded shard: drop its slice of this step's gradients
-                # (async SGD tolerates a lost update the same way it
-                # tolerates staleness) and keep the healthy shards'
-                # updates. The worker keeps training on work that doesn't
-                # need the dead shard.
-                last_err = e
-                self._mark_degraded(ps_id, e)
-                _DROPPED_PUSHES.inc()
-                continue
-            self._mark_healthy(ps_id)
-            delivered += 1
-            accepted = accepted and res.accepted
-            max_version = max(max_version, res.version)
+        serialize_s = time.perf_counter() - serialize_start
+        wait_start = time.perf_counter()
+        apply_s = 0.0
+        with tracing.span("ps_push_wait"):
+            futures = [
+                (
+                    ps_id,
+                    self._stubs[ps_id].push_gradients.future(
+                        pb.PushGradientsRequest(
+                            gradients=m,
+                            learning_rate=learning_rate,
+                            worker_id_plus_one=(
+                                self._worker_id + 1
+                                if self._worker_id >= 0
+                                else 0
+                            ),
+                            batch_size=batch_size,
+                        )
+                    ),
+                )
+                for ps_id, m in shard_models.items()
+            ]
+            accepted, max_version = True, 0
+            delivered, last_err = 0, None
+            for ps_id, f in futures:
+                try:
+                    res = f.result()
+                except grpc.RpcError as e:
+                    # Degraded shard: drop its slice of this step's
+                    # gradients (async SGD tolerates a lost update the
+                    # same way it tolerates staleness) and keep the
+                    # healthy shards' updates. The worker keeps training
+                    # on work that doesn't need the dead shard.
+                    last_err = e
+                    self._mark_degraded(ps_id, e)
+                    _DROPPED_PUSHES.inc()
+                    continue
+                self._mark_healthy(ps_id)
+                delivered += 1
+                accepted = accepted and res.accepted
+                max_version = max(max_version, res.version)
+                apply_s = max(apply_s, res.apply_seconds)
+        if self.timing is not None:
+            wait_s = time.perf_counter() - wait_start
+            self.timing.add("push_serialize", serialize_s)
+            self.timing.add("push_apply", apply_s)
+            self.timing.add("push_wire", max(wait_s - apply_s, 0.0))
         if not delivered and last_err is not None:
             # Every shard refused: no progress is being recorded anywhere;
             # surface the failure so the retry ladder (and ultimately the

@@ -61,6 +61,13 @@ class ParameterServerTrainer(JaxTrainer):
     ):
         super().__init__(model, loss_fn, optimizer_spec, seed=seed)
         self._ps = ps_client
+        # Bind this trainer's Timing to the client so push_gradients
+        # decomposes into push_serialize/push_wire/push_apply sub-phases
+        # alongside the trainer's own pull/prefetch/step/push phases
+        # (Timing is thread-safe: the pipelined path pushes from the
+        # background thread).
+        if getattr(ps_client, "timing", None) is None:
+            ps_client.timing = self.timing
         # bf16 wire dtype extends ACROSS the host<->device hop, not just
         # the TCP wire: prefetched rows upload as bf16 (widened to f32 on
         # the chip — exact) and the jitted step hands embedding grads

@@ -375,11 +375,19 @@ def test_zero1_weight_update_sharding_matches_replicated():
 def test_zero1_multihost_layout_matches_replicated():
     """The multi-host ZeRO-1 layout — a {data: n_proc, zero: local} mesh
     with the batch sharded over both axes and optimizer state sharded
-    over "zero" only — must train bit-identically to the replicated
-    baseline, keep every opt leaf fully addressable (the regroup
-    snapshot's requirement), and actually shard over the zero axis.
-    Emulated in one process by forcing the two-axis mesh the trainer
-    builds when jax.process_count() > 1."""
+    over "zero" only — must train numerically equivalently to the
+    replicated baseline, keep every opt leaf fully addressable (the
+    regroup snapshot's requirement), and actually shard over the zero
+    axis. Emulated in one process by forcing the two-axis mesh the
+    trainer builds when jax.process_count() > 1.
+
+    "Numerically equivalently", not bit-identically: XLA lowers the
+    same jitted step differently for the {data: 8} and
+    {data: 2, zero: 4} meshes, and cross-device reduction ORDER is part
+    of that lowering — on this image's CPU backend the losses drift at
+    ~1e-7 relative by step 4 (pre-existing tier-1 failure, triaged in
+    PR 5). A tight relative tolerance still catches every real layout
+    bug (wrong shard math shows up at 1e-2, not 1e-7)."""
     import jax
 
     from elasticdl_tpu.models.transformer import transformer_lm as tlm
@@ -421,7 +429,7 @@ def test_zero1_multihost_layout_matches_replicated():
 
     base_losses, _, _ = run(zero1=False, force_two_axis=False)
     z_losses, opt_state, mesh = run(zero1=True, force_two_axis=True)
-    assert base_losses == z_losses
+    np.testing.assert_allclose(base_losses, z_losses, rtol=1e-5)
     assert mesh.shape == {"data": 2, "zero": 4}
     sharded = 0
     for leaf in jax.tree_util.tree_leaves(opt_state):

@@ -1,0 +1,433 @@
+"""Jax-free tests for the bench evidence machinery: bootstrap CIs,
+significance verdicts over synthetic BENCH JSON pairs, baseline
+parsing (including the r05-style timeout wrapper), the regression
+gate's exit codes, and the runner's always-emit-the-JSON-line
+guarantee under wedged/raising benchmarks."""
+
+import json
+import statistics
+import time
+
+import pytest
+
+from elasticdl_tpu.bench import gate, runner, stats
+from elasticdl_tpu.bench.budget import BudgetClock, run_with_watchdog
+from elasticdl_tpu.observability import flightrec
+
+
+# ---------------------------------------------------------------------------
+# bootstrap CI
+# ---------------------------------------------------------------------------
+
+
+def test_bootstrap_ci_brackets_median_and_is_deterministic():
+    samples = [100.0, 102.0, 98.0, 101.0, 99.0, 100.5, 97.5, 103.0]
+    ci = stats.bootstrap_ci(samples, seed=7)
+    assert ci is not None
+    lo, hi = ci
+    assert lo <= statistics.median(samples) <= hi
+    assert min(samples) <= lo <= hi <= max(samples)
+    assert stats.bootstrap_ci(samples, seed=7) == ci  # seeded = stable
+    assert stats.bootstrap_ci(samples, seed=8) != ci
+
+
+def test_bootstrap_ci_refuses_tiny_samples():
+    assert stats.bootstrap_ci([1.0, 2.0]) is None
+    assert stats.bootstrap_ci([]) is None
+    summary = stats.summarize([5.0, 6.0])
+    assert summary["n"] == 2 and "ci95" not in summary
+    assert "median" in summary
+
+
+def test_summarize_fields():
+    s = stats.summarize([10.0, 20.0, 30.0, 40.0])
+    assert s["median"] == 25.0
+    assert s["n"] == 4
+    assert s["spread"] == pytest.approx(4.0)
+    assert s["ci95"][0] <= s["median"] <= s["ci95"][1]
+
+
+# ---------------------------------------------------------------------------
+# significance verdict
+# ---------------------------------------------------------------------------
+
+BASE = [100.0, 101.0, 99.0, 100.5, 99.5, 100.2, 99.8]
+
+
+def test_verdict_regression():
+    cand = [s * 0.80 for s in BASE]  # -20%: real and practical
+    v = stats.significance_verdict(BASE, cand)
+    assert v["verdict"] == stats.VERDICT_REGRESSION
+    assert v["effect"] == pytest.approx(-0.20, abs=0.02)
+    assert v["effect_ci"][1] < 0
+
+
+def test_verdict_improvement():
+    cand = [s * 1.25 for s in BASE]
+    v = stats.significance_verdict(BASE, cand)
+    assert v["verdict"] == stats.VERDICT_IMPROVEMENT
+
+
+def test_verdict_noise_small_effect():
+    # Statistically detectable but below min_effect: the ±2% ResNet
+    # drift must be labeled noise, not regression.
+    cand = [s * 0.99 for s in BASE]
+    v = stats.significance_verdict(BASE, cand, min_effect=0.02)
+    assert v["verdict"] == stats.VERDICT_NOISE
+
+
+def test_verdict_noise_overlapping_distributions():
+    cand = [100.3, 99.2, 100.8, 99.7, 100.1, 99.9, 100.4]
+    v = stats.significance_verdict(BASE, cand)
+    assert v["verdict"] == stats.VERDICT_NOISE
+
+
+def test_verdict_insufficient_data():
+    v = stats.significance_verdict(BASE, [80.0])
+    assert v["verdict"] == stats.VERDICT_INSUFFICIENT
+    # The point effect is still reported — evidence, not a claim.
+    assert v["effect"] == pytest.approx(-0.20, abs=0.02)
+    assert stats.significance_verdict([], BASE)["verdict"] == (
+        stats.VERDICT_INSUFFICIENT
+    )
+
+
+# ---------------------------------------------------------------------------
+# BENCH_*.json parsing
+# ---------------------------------------------------------------------------
+
+
+def _bench_record(samples, device="TPU v5e", bench="resnet50"):
+    return {
+        "metric": "examples/sec/chip",
+        "value": statistics.median(samples),
+        "unit": "examples/sec",
+        "vs_baseline": None,
+        "details": {
+            "device_kind": device,
+            bench: {
+                "examples_per_sec": statistics.median(samples),
+                "samples": list(samples),
+            },
+        },
+    }
+
+
+def test_extract_raw_record_passthrough():
+    rec = _bench_record(BASE)
+    assert stats.extract_bench_record(rec) is rec
+
+
+def test_extract_from_driver_wrapper_tail():
+    rec = _bench_record(BASE)
+    wrapper = {
+        "n": 6,
+        "cmd": "python bench.py",
+        "rc": 0,
+        "tail": "[INFO] noise\n" + json.dumps(rec) + "\n",
+    }
+    got = stats.extract_bench_record(wrapper)
+    assert got is not None
+    assert got["details"]["resnet50"]["samples"] == BASE
+
+
+def test_extract_timeout_wrapper_yields_none():
+    # The r05 shape: killed before the JSON line was ever printed.
+    wrapper = {"n": 5, "rc": 124, "tail": "[INFO] PS 0/2 serving\n" * 40}
+    assert stats.extract_bench_record(wrapper) is None
+    assert stats.extract_bench_record({"rc": 0}) is None
+    assert stats.extract_bench_record("not a dict") is None
+
+
+def test_comparable_metrics_new_and_legacy_shapes():
+    new = _bench_record(BASE)
+    metrics = stats.comparable_metrics(new)
+    assert metrics == {"resnet50": BASE}
+    legacy = {
+        "metric": "m",
+        "details": {
+            "deepfm_ps_mode": {
+                "serialized": {
+                    "examples_per_sec": 8495.5,
+                    "runs_examples_per_sec": [8495.5, 7740.9],
+                },
+            },
+            "resnet50": {"examples_per_sec": 2569.7},
+        },
+    }
+    metrics = stats.comparable_metrics(legacy)
+    assert metrics["deepfm_ps_mode.serialized"] == [8495.5, 7740.9]
+    assert metrics["resnet50"] == [2569.7]  # point value, 1 sample
+
+
+def test_compare_records_device_guard():
+    base = _bench_record(BASE, device="TPU v5e")
+    cand = _bench_record([s * 0.5 for s in BASE], device="cpu")
+    v = stats.compare_records(base, cand)
+    assert v["overall"] == stats.VERDICT_INCOMPARABLE
+    assert v["metrics"] == {}
+
+
+def test_compare_records_flags_the_regressed_metric():
+    base = _bench_record(BASE)
+    base["details"]["deepfm_criteo"] = {
+        "examples_per_sec": 200.0,
+        "samples": [200.0, 201.0, 199.0, 200.5, 199.5],
+    }
+    cand = _bench_record(BASE)  # resnet unchanged
+    cand["details"]["deepfm_criteo"] = {
+        "examples_per_sec": 150.0,
+        "samples": [150.0, 151.0, 149.0, 150.5, 149.5],
+    }
+    v = stats.compare_records(base, cand)
+    assert v["overall"] == stats.VERDICT_REGRESSION
+    assert v["metrics"]["deepfm_criteo"]["verdict"] == (
+        stats.VERDICT_REGRESSION
+    )
+    assert v["metrics"]["resnet50"]["verdict"] == stats.VERDICT_NOISE
+
+
+# ---------------------------------------------------------------------------
+# the regression gate
+# ---------------------------------------------------------------------------
+
+
+def _write(path, obj):
+    path.write_text(json.dumps(obj))
+
+
+def test_gate_fails_on_synthetic_regression(tmp_path):
+    _write(tmp_path / "BENCH_r01.json", _bench_record(BASE))
+    _write(
+        tmp_path / "BENCH_r02.json",
+        _bench_record([s * 0.8 for s in BASE]),
+    )
+    assert gate.run_gate(root=str(tmp_path)) == 1
+
+
+def test_gate_passes_no_change_and_improvement(tmp_path):
+    _write(tmp_path / "BENCH_r01.json", _bench_record(BASE))
+    _write(
+        tmp_path / "BENCH_r02.json",
+        _bench_record([s * 1.005 for s in BASE]),
+    )
+    assert gate.run_gate(root=str(tmp_path)) == 0
+    _write(
+        tmp_path / "BENCH_r03.json",
+        _bench_record([s * 1.3 for s in BASE]),
+    )
+    assert gate.run_gate(root=str(tmp_path)) == 0
+
+
+def test_gate_skips_unparseable_rounds_and_device_changes(tmp_path):
+    _write(tmp_path / "BENCH_r04.json", _bench_record(BASE))
+    # r05: the timeout wrapper — must be skipped, not crash the gate.
+    _write(tmp_path / "BENCH_r05.json", {"rc": 124, "tail": "no json"})
+    _write(
+        tmp_path / "BENCH_r06.json",
+        _bench_record([s * 0.5 for s in BASE], device="cpu"),
+    )
+    # candidate r06 (cpu) vs baseline r04 (tpu): incomparable -> pass.
+    assert gate.run_gate(root=str(tmp_path)) == 0
+    # Explicit same-device pair still gates.
+    assert (
+        gate.run_gate(
+            baseline_path=str(tmp_path / "BENCH_r04.json"),
+            candidate_path=str(tmp_path / "BENCH_r04.json"),
+            root=str(tmp_path),
+        )
+        == 0
+    )
+
+
+def test_gate_prefers_same_device_baseline(tmp_path):
+    """One checked-in CPU round must not blind the gate: a later TPU
+    candidate reaches past it to the newest TPU baseline and still
+    FAILS on a real regression instead of auto-passing incomparable."""
+    _write(
+        tmp_path / "BENCH_r04.json",
+        _bench_record(BASE, device="TPU v5e"),
+    )
+    _write(
+        tmp_path / "BENCH_r06.json",
+        _bench_record([s * 0.1 for s in BASE], device="cpu"),
+    )
+    _write(
+        tmp_path / "BENCH_r07.json",
+        _bench_record([s * 0.7 for s in BASE], device="TPU v5e"),
+    )
+    assert gate.run_gate(root=str(tmp_path)) == 1
+    # And an unregressed same-device candidate still passes.
+    _write(
+        tmp_path / "BENCH_r08.json",
+        _bench_record([s * 1.01 for s in BASE], device="TPU v5e"),
+    )
+    assert gate.run_gate(root=str(tmp_path)) == 0
+
+
+def test_gate_empty_root_passes(tmp_path):
+    assert gate.run_gate(root=str(tmp_path)) == 0
+
+
+def test_gate_cli_explicit_paths(tmp_path):
+    base, cand = tmp_path / "base.json", tmp_path / "cand.json"
+    _write(base, _bench_record(BASE))
+    _write(cand, _bench_record([s * 0.7 for s in BASE]))
+    assert (
+        gate.main(
+            ["--baseline", str(base), "--candidate", str(cand)]
+        )
+        == 1
+    )
+    assert (
+        gate.main(
+            ["--baseline", str(base), "--candidate", str(base)]
+        )
+        == 0
+    )
+
+
+# ---------------------------------------------------------------------------
+# budget + truncated-run emission
+# ---------------------------------------------------------------------------
+
+
+def test_budget_clock():
+    clock = BudgetClock(0)
+    assert not clock.expired and clock.remaining() == float("inf")
+    clock = BudgetClock(1000)
+    assert clock.fits(10) and not clock.expired
+    clock = BudgetClock(1e-9)
+    time.sleep(0.01)
+    assert clock.expired and not clock.fits(1)
+
+
+def test_watchdog_returns_error_slots():
+    assert run_with_watchdog("ok", lambda: {"x": 1}, 5) == {"x": 1}
+    result = run_with_watchdog(
+        "boom", lambda: 1 / 0, 5
+    )
+    assert "division" in result["error"]
+    named = []
+    result = run_with_watchdog(
+        "wedge", lambda: time.sleep(30), 0.2,
+        on_timeout=named.append,
+    )
+    assert result["timed_out"] and named == ["wedge"]
+
+
+def test_truncated_run_still_emits_schema_valid_json(
+    tmp_path, capsys, monkeypatch
+):
+    """A run where one bench wedges (watchdog) and another raises must
+    still print exactly one schema-valid JSON result line, with each
+    failure in its own slot — the BENCH_r05 failure mode, fixed — and
+    the wedged benchmark must leave a flight-recorder dump naming the
+    phase the watchdog abandoned."""
+    monkeypatch.setenv("ELASTICDL_FLIGHTREC_DIR", str(tmp_path))
+    out_path = tmp_path / "result.json"
+    try:
+        rc = runner.run_smoke(
+            watchdog_s=0.3,
+            out_path=str(out_path),
+            benches={
+                "wedged": lambda: time.sleep(30),
+                "raising": lambda: (_ for _ in ()).throw(
+                    RuntimeError("synthetic failure")
+                ),
+                "fine": lambda: {"examples_per_sec": 123.0},
+            },
+        )
+    finally:
+        flightrec.uninstall()
+    assert rc == 1
+    lines = [
+        line
+        for line in capsys.readouterr().out.splitlines()
+        if line.startswith("{")
+    ]
+    assert len(lines) == 1
+    result = json.loads(lines[0])
+    runner.validate_result(result)  # must not raise
+    details = result["details"]
+    assert details["wedged"]["timed_out"]
+    assert "synthetic failure" in details["raising"]["error"]
+    assert details["fine"]["examples_per_sec"] == 123.0
+    assert details["failures"] == 2
+    # --out wrote the same line atomically.
+    assert json.loads(out_path.read_text()) == result
+    # The watchdog dumped flight evidence naming the abandoned phase.
+    dump = json.loads((tmp_path / "flightrec-bench.json").read_text())
+    assert dump["reason"] == "watchdog-timeout:wedged"
+    assert "wedged" in [p["name"] for p in dump["open_phases"]]
+
+
+def test_spent_budget_skips_remaining_benches(
+    tmp_path, capsys, monkeypatch
+):
+    """Once the budget is gone the runner must SKIP benchmarks (recorded,
+    not failed) rather than start them — the result line has to reach
+    stdout before whatever outer wall killed BENCH_r05."""
+    monkeypatch.setenv("ELASTICDL_FLIGHTREC_DIR", str(tmp_path))
+    try:
+        rc = runner.run_smoke(
+            watchdog_s=5,
+            budget_s=1e-9,  # expired before the first bench
+            benches={
+                "a": lambda: {"examples_per_sec": 1.0},
+                "b": lambda: {"examples_per_sec": 2.0},
+            },
+        )
+    finally:
+        flightrec.uninstall()
+    lines = [
+        line
+        for line in capsys.readouterr().out.splitlines()
+        if line.startswith("{")
+    ]
+    result = json.loads(lines[0])
+    runner.validate_result(result)
+    assert result["details"]["a"] == {"skipped": "budget"}
+    assert result["details"]["b"] == {"skipped": "budget"}
+    assert rc == 0  # skipped-for-budget is not a harness failure
+
+
+def test_validate_result_rejects_partial_lines():
+    with pytest.raises(ValueError):
+        runner.validate_result({"metric": "m", "value": 1})
+    with pytest.raises(ValueError):
+        runner.validate_result(
+            {
+                "metric": "m", "value": 1, "unit": "u",
+                "vs_baseline": None, "details": "not a dict",
+            }
+        )
+
+
+def test_attach_verdict_no_baseline(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "ELASTICDL_BENCH_BASELINE", str(tmp_path / "missing.json")
+    )
+    details = {"device_kind": "cpu"}
+    runner.attach_verdict(details)
+    assert details["verdict"]["overall"] == "no-baseline"
+
+
+def test_attach_verdict_against_explicit_baseline(tmp_path, monkeypatch):
+    baseline = tmp_path / "BENCH_r01.json"
+    _write(baseline, _bench_record(BASE, device="cpu"))
+    monkeypatch.setenv("ELASTICDL_BENCH_BASELINE", str(baseline))
+    details = {
+        "device_kind": "cpu",
+        "resnet50": {
+            "examples_per_sec": 70.0,
+            "samples": [70.0, 71.0, 69.0, 70.5, 69.5],
+        },
+    }
+    runner.attach_verdict(details)
+    v = details["verdict"]
+    assert v["overall"] == stats.VERDICT_REGRESSION
+    assert v["baseline_file"] == "BENCH_r01.json"
+    assert v["metrics"]["resnet50"]["verdict"] == (
+        stats.VERDICT_REGRESSION
+    )

@@ -1,0 +1,144 @@
+"""Flight-recorder tests: the ring records spans from the tracing
+plane, open phases are attributed, and a SIGTERM'd process leaves a
+dump naming the phase it died in. Jax-free."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from elasticdl_tpu.observability import flightrec, tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _install(tmp_path, role="t", capacity=64):
+    rec = flightrec.install(
+        role, capacity=capacity, dump_dir=str(tmp_path),
+        arm_signals=False,
+    )
+    assert rec is not None
+    return rec
+
+
+def test_ring_records_tracing_spans_and_dumps(tmp_path):
+    try:
+        rec = _install(tmp_path)
+        with tracing.span("pull_model", step=3):
+            pass
+        with tracing.span("rpc_client/PServer/push_gradients", cat="rpc"):
+            time.sleep(0.01)
+        path = flightrec.dump("unit-test")
+        assert path == str(tmp_path / "flightrec-t.json")
+        snap = json.loads((tmp_path / "flightrec-t.json").read_text())
+        assert snap["role"] == "t"
+        assert snap["reason"] == "unit-test"
+        names = [e["name"] for e in snap["events"]]
+        assert "pull_model" in names
+        pull = snap["events"][names.index("pull_model")]
+        assert pull["args"] == {"step": 3}
+        # RPC spans aggregate per method too.
+        agg = snap["rpc"]["rpc_client/PServer/push_gradients"]
+        assert agg["count"] == 1 and agg["total_ms"] >= 10
+        assert rec is flightrec.get()
+    finally:
+        flightrec.uninstall()
+    # Disarmed: spans no longer reach a recorder, dump is a no-op.
+    assert flightrec.dump("after") is None
+    assert flightrec.get() is None
+
+
+def test_open_phase_named_innermost_last(tmp_path):
+    try:
+        _install(tmp_path)
+        with flightrec.phase("bench:deepfm_ps"):
+            with flightrec.phase("ps_matrix:ps2-overlapped-bf16"):
+                flightrec.dump("mid-phase")
+        snap = json.loads((tmp_path / "flightrec-t.json").read_text())
+        open_names = [p["name"] for p in snap["open_phases"]]
+        assert open_names == [
+            "bench:deepfm_ps", "ps_matrix:ps2-overlapped-bf16",
+        ]
+        # After exit the phases CLOSE into the ring and the open set
+        # empties.
+        flightrec.dump("after-phase")
+        snap = json.loads((tmp_path / "flightrec-t.json").read_text())
+        assert snap["open_phases"] == []
+        closed = [
+            e["name"] for e in snap["events"] if e["cat"] == "phase"
+        ]
+        assert "ps_matrix:ps2-overlapped-bf16" in closed
+    finally:
+        flightrec.uninstall()
+
+
+def test_ring_is_bounded(tmp_path):
+    try:
+        _install(tmp_path, capacity=16)
+        for i in range(100):
+            with tracing.span(f"s{i}"):
+                pass
+        flightrec.dump("bounded")
+        snap = json.loads((tmp_path / "flightrec-t.json").read_text())
+        names = [e["name"] for e in snap["events"]]
+        assert len(names) == 16
+        assert names[-1] == "s99" and names[0] == "s84"  # newest kept
+    finally:
+        flightrec.uninstall()
+
+
+def test_knob_disables(tmp_path, monkeypatch):
+    monkeypatch.setenv("ELASTICDL_FLIGHTREC", "0")
+    assert (
+        flightrec.install("t", dump_dir=str(tmp_path), arm_signals=False)
+        is None
+    )
+    assert flightrec.get() is None
+
+
+_SIGTERM_CHILD = """
+import sys, time
+sys.path.insert(0, {repo!r})
+from elasticdl_tpu.observability import flightrec, tracing
+rec = flightrec.install("benchkid", capacity=64, dump_dir={d!r})
+with tracing.span("warmup"):
+    pass
+with rec.phase("bench:ps_matrix"):
+    with rec.phase("ps_matrix:ps2-serial-f32"):
+        print("READY", flush=True)
+        time.sleep(60)
+"""
+
+
+def test_sigterm_dumps_and_names_the_dying_phase(tmp_path):
+    """Kill a 'bench' mid-phase: the process must die with the SIGTERM
+    wait status (handler chains to the default) AND leave
+    flightrec-<role>.json naming the phase it was in."""
+    child = subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            _SIGTERM_CHILD.format(repo=REPO, d=str(tmp_path)),
+        ],
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        line = child.stdout.readline()
+        assert line.strip() == "READY"
+        child.send_signal(signal.SIGTERM)
+        rc = child.wait(timeout=30)
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait(timeout=10)
+    assert rc == -signal.SIGTERM  # died OF the signal, not exit(0)
+    dump_path = tmp_path / "flightrec-benchkid.json"
+    assert dump_path.exists()
+    snap = json.loads(dump_path.read_text())
+    assert snap["reason"] == "signal:SIGTERM"
+    open_names = [p["name"] for p in snap["open_phases"]]
+    assert open_names == ["bench:ps_matrix", "ps_matrix:ps2-serial-f32"]
+    assert any(e["name"] == "warmup" for e in snap["events"])

@@ -27,7 +27,7 @@ def _flagship_mfu(cfg, n_params, tokens_per_sec):
     excluded, LM head included) + 12*L*d*S per token for the attention
     score/value matmuls. Remat recompute is deliberately NOT counted —
     MFU measures model math retired, not hardware work."""
-    from bench import _peak_flops
+    from elasticdl_tpu.bench.workloads import _peak_flops
 
     embed_params = cfg.vocab * cfg.d_model + cfg.max_len * cfg.d_model
     matmul_params = n_params - embed_params
