@@ -13,7 +13,12 @@ test:
 # a lint failure fails verify before any test runs (the lint plane needs
 # no jax and finishes in seconds). Bounded wall clock, collection errors
 # tolerated, deterministic plugin set, pass-count echoed for the driver.
-verify: lint
+verify: lint verify-tests
+
+# The tier-1 window itself, lint-free (make ci runs lint as its own
+# stage so the one-line summary attributes the failure to the right
+# lane).
+verify-tests:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Harness self-check: tiny shapes, CPU-safe, < 60 s, per-bench watchdog,
@@ -56,4 +61,15 @@ obs:
 native:
 	@if [ -f elasticdl_tpu/native/Makefile ]; then $(MAKE) -C elasticdl_tpu/native; else echo "native kernels not present yet"; fi
 
-.PHONY: proto test verify bench-smoke bench-gate lint lint-changed chaos obs native
+# The CI lane: lint -> tier-1 -> bench regression gate, each stage runs
+# even when an earlier one fails (one run answers "what is broken"), and
+# the single trailing CI: line is the machine-readable verdict.
+ci:
+	@lint=FAIL; tier1=FAIL; gate=FAIL; \
+	$(MAKE) --no-print-directory lint && lint=ok; \
+	$(MAKE) --no-print-directory verify-tests && tier1=ok; \
+	$(MAKE) --no-print-directory bench-gate && gate=ok; \
+	echo "CI: lint=$$lint tier1=$$tier1 bench-gate=$$gate"; \
+	[ "$$lint" = ok ] && [ "$$tier1" = ok ] && [ "$$gate" = ok ]
+
+.PHONY: proto test verify verify-tests bench-smoke bench-gate lint lint-changed chaos obs native ci

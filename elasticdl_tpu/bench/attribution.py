@@ -1,0 +1,205 @@
+"""Per-workload step-time attribution for bench runs (jax-free).
+
+Answers "where did this step go" with one row per measured workload (or
+per cell, for cell-bearing workloads like the PS matrix): the fraction
+of step time spent in
+
+    compute     the jitted device step (train_step / windowed loop)
+    serialize   gradient dedup + proto build before the push RPC
+    ps_wire     waiting on the PS over the wire (push wait net of the
+                shard-reported apply, plus apply itself — the far side
+                of the push — and the dense pull)
+    input_wait  embedding prefetch / data feed ahead of the step
+    recompile   tracked lowerings that fired during the workload's
+                wall-clock window (the compile tracker's delta)
+    other       the un-attributed remainder (host glue, GC, ...)
+
+Fractions are measured against each row's step time and OVERLAP-
+NORMALIZED: pipelined configs run the push concurrently with the next
+step's pull/compute, so raw phase means can sum past the step — when
+they do, every fraction is scaled by 1/sum so the row reads as shares
+of the step and sums to <= 1.0 by construction. Rows whose phases were
+measured serially keep their true remainder in `other`.
+
+The runner feeds `build_all` with each workload's result dict, its
+wall-clock seconds, and the compile-seconds delta the tracker observed
+around it; `render_table` prints the human table `make bench-smoke`
+ships to stderr (stdout stays the single JSON result line).
+"""
+
+# Result-dict phase keys -> attribution buckets. phase_mean_ms comes
+# from the trainer Timing (matrix.run_ps_config); push_breakdown_ms is
+# the serialize/wire/apply split inside push_gradients.
+_PHASE_BUCKETS = {
+    "train_step": "compute",
+    "train_step_dispatch": "compute",
+    "pull_model": "ps_wire",
+    "prefetch_embeddings": "input_wait",
+}
+_BREAKDOWN_BUCKETS = {
+    "serialize": "serialize",
+    "wire": "ps_wire",
+    "apply": "ps_wire",
+}
+
+FRACTION_KEYS = (
+    "compute", "ps_wire", "serialize", "input_wait", "recompile", "other"
+)
+
+
+def _normalize(fractions):
+    """Clamp negatives, overlap-normalize past 1.0, derive `other`.
+    The sum<=1.0 invariant holds on the ROUNDED values too (rounding
+    each share up by half an ulp must not break what normalization just
+    established): any rounding excess is shaved off the largest share."""
+    out = {k: max(0.0, v) for k, v in fractions.items() if v}
+    total = sum(out.values())
+    if total > 1.0:
+        out = {k: v / total for k, v in out.items()}
+        out["overlapped"] = True
+        total = 1.0
+    out["other"] = max(0.0, round(1.0 - total, 4))
+    out = {
+        k: (round(v, 4) if isinstance(v, float) else v)
+        for k, v in out.items()
+    }
+    numeric = [k for k, v in out.items() if isinstance(v, float)]
+    excess = round(sum(out[k] for k in numeric) - 1.0, 4)
+    if excess > 0:
+        biggest = max(numeric, key=lambda k: out[k])
+        out[biggest] = round(out[biggest] - excess, 4)
+    return out
+
+
+def from_phases(step_time_ms, phase_mean_ms, push_breakdown_ms=None,
+                recompile_fraction=0.0):
+    """Attribution for one PS-mode cell from its per-step phase means."""
+    if not step_time_ms:
+        return None
+    fractions = {"recompile": recompile_fraction}
+    for phase, bucket in _PHASE_BUCKETS.items():
+        ms = (phase_mean_ms or {}).get(phase)
+        if ms:
+            fractions[bucket] = fractions.get(bucket, 0.0) + (
+                ms / step_time_ms
+            )
+    breakdown = push_breakdown_ms or {}
+    for part, bucket in _BREAKDOWN_BUCKETS.items():
+        ms = breakdown.get(part)
+        if ms:
+            fractions[bucket] = fractions.get(bucket, 0.0) + (
+                ms / step_time_ms
+            )
+    # push_gradients minus its breakdown is serialize-path glue
+    # (device_get, partitioning); fold the un-split remainder into
+    # serialize so serial cells don't under-report the push.
+    push_ms = (phase_mean_ms or {}).get("push_gradients")
+    if push_ms:
+        split = sum(breakdown.values())
+        if push_ms > split:
+            fractions["serialize"] = fractions.get(
+                "serialize", 0.0
+            ) + (push_ms - split) / step_time_ms
+    return _normalize(fractions)
+
+
+def from_windows(result, wall_s, compile_s):
+    """Attribution for a windowed jitted-loop bench: the timed windows
+    are pure device compute; everything else in the wall is compile +
+    harness."""
+    step_ms = result.get("step_time_ms")
+    windows = result.get("windows")
+    steps = result.get("steps_per_window")
+    if not (step_ms and windows and steps and wall_s):
+        return None
+    measured_s = step_ms / 1e3 * windows * steps
+    return _normalize(
+        {
+            "compute": measured_s / wall_s,
+            "recompile": min(1.0, compile_s / wall_s),
+        }
+    )
+
+
+def build(result, wall_s, compile_s):
+    """{row_label: fractions} for one workload result (possibly cell-
+    bearing). Empty dict when the result carries nothing attributable
+    (errors, skips, drills)."""
+    out = {}
+    if not isinstance(result, dict) or "error" in result:
+        return out
+    recompile_fraction = (
+        min(1.0, compile_s / wall_s) if wall_s else 0.0
+    )
+    if "phase_mean_ms" in result:
+        row = from_phases(
+            result.get("step_time_ms"),
+            result.get("phase_mean_ms"),
+            result.get("push_breakdown_ms"),
+            recompile_fraction,
+        )
+        if row:
+            out[""] = row
+        return out
+    if "windows" in result:
+        row = from_windows(result, wall_s, compile_s)
+        if row:
+            out[""] = row
+        return out
+    # Cell-bearing results: bench_deepfm_ps keys its configs at the top
+    # level, the PS matrix nests them under "cells". Cell rows get NO
+    # share of the workload-level compile seconds: each cell's timed
+    # window opens after its own warmup (compiles land outside it), and
+    # folding one wall-clock fraction into every cell would count the
+    # same compile N times against step-time denominators it never ran
+    # in.
+    cell_host = result.get("cells") if isinstance(
+        result.get("cells"), dict
+    ) else result
+    for cell, sub in cell_host.items():
+        if not isinstance(sub, dict) or "phase_mean_ms" not in sub:
+            continue
+        row = from_phases(
+            sub.get("step_time_ms"),
+            sub.get("phase_mean_ms"),
+            sub.get("push_breakdown_ms"),
+        )
+        if row:
+            out[cell] = row
+    return out
+
+
+def build_all(measured):
+    """measured: {workload: (result, wall_s, compile_s)} ->
+    {workload[/cell]: fractions} for every attributable row."""
+    table = {}
+    for name, (result, wall_s, compile_s) in measured.items():
+        for cell, row in build(result, wall_s, compile_s).items():
+            table[f"{name}/{cell}" if cell else name] = row
+    return table
+
+
+def render_table(table):
+    """Fixed-width human table (stderr companion of the JSON line)."""
+    if not table:
+        return "attribution: no attributable workloads"
+    width = max(len(k) for k in table)
+    head = "  ".join(f"{k:>10}" for k in FRACTION_KEYS)
+    lines = [
+        "step-time attribution (fractions of step time; "
+        "rows sum to <= 1.0):",
+        f"{'workload':<{width}}  {head}",
+    ]
+    for name in sorted(table):
+        row = table[name]
+        cells = "  ".join(
+            f"{row.get(k, 0.0):>10.3f}" for k in FRACTION_KEYS
+        )
+        mark = " *" if row.get("overlapped") else ""
+        lines.append(f"{name:<{width}}  {cells}{mark}")
+    if any(r.get("overlapped") for r in table.values()):
+        lines.append(
+            "(* overlap-normalized: pipelined phases measured "
+            "concurrently)"
+        )
+    return "\n".join(lines)

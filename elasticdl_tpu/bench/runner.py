@@ -23,11 +23,13 @@ emission/verdict machinery is testable in milliseconds.
 
 import json
 import os
+import sys
+import time
 
-from elasticdl_tpu.bench import stats
+from elasticdl_tpu.bench import attribution, stats
 from elasticdl_tpu.bench.budget import BudgetClock, run_with_watchdog
 from elasticdl_tpu.common import knobs
-from elasticdl_tpu.observability import flightrec
+from elasticdl_tpu.observability import flightrec, profiling
 
 REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -117,6 +119,31 @@ def _watchdog(name, fn, timeout_s):
         )
 
 
+def _measured(name, fn, timeout_s, measured, key):
+    """Run one bench under the watchdog while measuring its wall clock
+    and the compile-tracker seconds delta — the inputs the step-time
+    attribution table (bench/attribution.py) needs per workload."""
+    t0 = time.perf_counter()
+    c0 = profiling.tracker().snapshot()[1]
+    result = _watchdog(name, fn, timeout_s)
+    wall = time.perf_counter() - t0
+    compile_s = max(0.0, profiling.tracker().snapshot()[1] - c0)
+    measured[key] = (result, wall, compile_s)
+    return result
+
+
+def _attach_attribution(details, measured):
+    """Fold the per-workload attribution into the result details and
+    print the human table to stderr (stdout stays the one JSON line)."""
+    try:
+        table = attribution.build_all(measured)
+        if table:
+            details["attribution"] = table
+        print(attribution.render_table(table), file=sys.stderr)
+    except Exception as e:  # evidence machinery must not sink the run
+        details["attribution_error"] = str(e)[:200]
+
+
 def run_full(watchdog_s=None, budget_s=None, with_matrix=True,
              out_path=None):
     """The full suite. Returns the process exit code."""
@@ -192,6 +219,7 @@ def run_full(watchdog_s=None, budget_s=None, with_matrix=True,
             watchdog_s, True,
         ),
     ]
+    measured = {}
     try:
         for key, name, fn, timeout_s, round_result in suite:
             # A spent budget SKIPS remaining benchmarks instead of
@@ -211,9 +239,10 @@ def run_full(watchdog_s=None, budget_s=None, with_matrix=True,
             # floor keeps the cap from becoming 0 = watchdog disabled.)
             if timeout_s and clock.total_s:
                 timeout_s = min(timeout_s, max(clock.remaining(), 1.0))
-            result = _watchdog(name, fn, timeout_s)
+            result = _measured(name, fn, timeout_s, measured, key)
             details[key] = _round_if_ok(result) if round_result else result
     finally:
+        _attach_attribution(details, measured)
         deepfm = details.get("deepfm_criteo") or {}
         if isinstance(deepfm, dict) and "examples_per_sec" in deepfm:
             details["deepfm_examples_per_sec_chip"] = round(
@@ -261,8 +290,6 @@ def run_smoke(watchdog_s=None, budget_s=None, out_path=None,
 
     ``benches`` overrides the registry ({name: fn}) — the truncated-run
     emission tests inject deliberately wedged/raising workloads."""
-    import time
-
     if watchdog_s is None:
         watchdog_s = 50.0
     if budget_s is None:
@@ -295,6 +322,7 @@ def run_smoke(watchdog_s=None, budget_s=None, out_path=None,
         }
     details = {}
     failures = 0
+    measured = {}
     start = time.perf_counter()
     try:
         for name, fn in benches.items():
@@ -304,11 +332,12 @@ def run_smoke(watchdog_s=None, budget_s=None, out_path=None,
             timeout_s = watchdog_s
             if timeout_s and clock.total_s:
                 timeout_s = min(timeout_s, max(clock.remaining(), 1.0))
-            result = _watchdog(name, fn, timeout_s)
+            result = _measured(name, fn, timeout_s, measured, name)
             details[name] = _round_if_ok(result)
             if not isinstance(result, dict) or "error" in result:
                 failures += 1
     finally:
+        _attach_attribution(details, measured)
         elapsed = time.perf_counter() - start
         details["elapsed_s"] = round(elapsed, 2)
         details["failures"] = failures

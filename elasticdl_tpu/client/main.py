@@ -483,6 +483,71 @@ def _top(args):
     return 0
 
 
+def _profile(args):
+    """On-demand deep profiling of a RUNNING job, plus the step-time
+    attribution report.
+
+    With --master_addr: ask the master's StartProfile RPC to fan a
+    jax.profiler capture out to every role (captures land under the
+    job's obs dir, profiles/<role>/) and print each role's capture
+    summary. With --obs_dir (no capture): print the step-time
+    attribution table tools/step_report.py builds from the traces,
+    compile events, and phase spans already on disk. Both flags
+    together capture first, then report."""
+    import json as _json
+
+    rc = 0
+    if args.master_addr:
+        import grpc
+
+        from elasticdl_tpu.common import rpc
+        from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+        stub = rpc.Stub(
+            rpc.build_channel(args.master_addr), rpc.MASTER_SERVICE
+        )
+        try:
+            # Explicit deadline derived from the capture length: the
+            # static METHOD_POLICIES deadline (120s) cannot know how
+            # long a capture THIS request asks for, and the master
+            # blocks for roughly seconds + fan-out margin.
+            resp = stub.start_profile(
+                pb.StartProfileRequest(
+                    seconds=args.seconds, role_prefix=args.role
+                ),
+                timeout=args.seconds + 90.0,
+            )
+        except grpc.RpcError as e:
+            print(
+                f"profile RPC failed: {e.code().name}", flush=True
+            )
+            return 2
+        results = _json.loads(resp.results_json or "{}")
+        print(f"captured {resp.captured}/{len(results)} roles:")
+        for role in sorted(results):
+            r = results[role]
+            if "error" in r:
+                print(f"  {role}: ERROR {r['error']}")
+            else:
+                print(
+                    f"  {role}: {r.get('bytes', 0)} bytes in "
+                    f"{len(r.get('files', []))} files -> {r.get('dir')}"
+                )
+        if resp.captured == 0:
+            rc = 1
+    if args.obs_dir:
+        try:
+            from tools import step_report
+        except ImportError:  # tools/ directly on sys.path
+            import step_report
+
+        print(step_report.render_report(args.obs_dir))
+    if not args.master_addr and not args.obs_dir:
+        print("edl profile needs --master_addr and/or --obs_dir")
+        return 2
+    return rc
+
+
 def _tensorboard(args):
     """Spawn TensorBoard over a job's metrics directory (reference
     master/tensorboard_service.py:21-62 spawns the CLI the same way; the
@@ -517,9 +582,32 @@ def main(argv=None):
     top.add_argument(
         "command",
         choices=["train", "evaluate", "predict", "zoo", "top", "dash",
-                 "tensorboard"],
+                 "tensorboard", "profile"],
     )
     ns, rest = top.parse_known_args(argv)
+
+    if ns.command == "profile":
+        prof = argparse.ArgumentParser("edl profile")
+        prof.add_argument(
+            "--master_addr",
+            default="",
+            help="capture: fan a device-profile capture out through the "
+            "master's StartProfile RPC",
+        )
+        prof.add_argument("--seconds", type=float, default=2.0)
+        prof.add_argument(
+            "--role",
+            default="",
+            help="only capture roles with this prefix (worker / ps / "
+            "master); empty = all",
+        )
+        prof.add_argument(
+            "--obs_dir",
+            default="",
+            help="report: print the step-time attribution table from "
+            "this job obs dir",
+        )
+        return _profile(prof.parse_args(rest))
 
     if ns.command == "tensorboard":
         tb = argparse.ArgumentParser("edl tensorboard")

@@ -177,6 +177,26 @@ declare("ELASTICDL_METRICS_HOST", "str", "",
         "the advertised scrape host when it names a real interface.")
 declare("ELASTICDL_AGGREGATOR_INTERVAL", "float", 2.0,
         "Master telemetry aggregator scrape period in seconds.")
+declare("ELASTICDL_OBS_MAX_LOG_MB", "float", 64.0,
+        "Size cap in MB for each observability log (traces.jsonl / "
+        "events.jsonl); crossing it rotates the file to <name>.1 with a "
+        "rotated marker event. 0 disables rotation.")
+declare("ELASTICDL_ENDPOINT_STALE_SCRAPES", "int", 5,
+        "Consecutive scrape failures after which the master's "
+        "aggregator stops scraping an advertised endpoint (counted in "
+        "edl_job_endpoints_stale; a rewritten advertisement resets it).")
+declare("ELASTICDL_COMPILE_TRACKER", "str", "auto",
+        "Compile tracker behind tracked_jit: 0/false/off degrades to a "
+        "plain jax.jit (no lowering accounting).")
+declare("ELASTICDL_PROFILE_MAX_SECONDS", "float", 30.0,
+        "Upper bound for one on-demand /debug/profile capture; longer "
+        "requests are clamped. 0 removes the clamp.")
+declare("ELASTICDL_MEM_SAMPLE_SECONDS", "float", 10.0,
+        "Memory accountant sampling period; 0 disables the background "
+        "sampler thread (direct samples still work).")
+declare("ELASTICDL_MEM_WATERMARK_RATIO", "float", 1.2,
+        "Factor by which a sample's live device bytes must exceed the "
+        "previous peak to emit a mem_high_watermark event.")
 declare("ELASTICDL_MFU", "str", "auto",
         "MFU instrumentation: 1/true forces on, 0/false forces off, "
         "\"auto\" activates only where observability.setup() ran.")

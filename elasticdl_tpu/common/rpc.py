@@ -109,6 +109,7 @@ MASTER_SERVICE = ServiceSpec(
         "report_lease": (pb.ReportLeaseRequest, pb.Empty),
         "report_worker_liveness": (pb.ReportWorkerLivenessRequest, pb.Empty),
         "get_job_status": (pb.GetJobStatusRequest, pb.JobStatusResponse),
+        "start_profile": (pb.StartProfileRequest, pb.StartProfileResponse),
     },
 )
 
@@ -190,6 +191,15 @@ METHOD_POLICIES = {
     "report_lease": RetryPolicy(deadline=30.0),
     "report_worker_liveness": RetryPolicy(deadline=30.0),
     "get_job_status": RetryPolicy(deadline=15.0),
+    # Profile fan-out blocks for the capture duration on every role; not
+    # idempotent (each attempt burns a capture slot on every endpoint),
+    # so a timed-out request is never replayed and connectivity failures
+    # retry once.
+    "start_profile": RetryPolicy(
+        deadline=120.0,
+        max_attempts=2,
+        retryable_codes=_RETRYABLE_CONNECTIVITY,
+    ),
     # Pserver service: payload-bearing; pushes that time out may have
     # applied, so only UNAVAILABLE replays them.
     "push_model": RetryPolicy(deadline=120.0),

@@ -7,6 +7,7 @@ results, evaluation metric reports, PS version reports (the evaluation
 trigger), comm-rank queries for elastic AllReduce, and worker liveness.
 """
 
+import os
 import threading
 import time
 
@@ -230,3 +231,46 @@ class MasterServicer:
         if self._membership is not None and request.host:
             self._membership.register(request.worker_id, request.host)
         return pb.Empty()
+
+    def start_profile(self, request, context):
+        """Fan an on-demand device-profile capture out to every
+        advertised endpoint (each role's /debug/profile HTTP endpoint),
+        blocking until the captures return. Endpoint discovery rides the
+        telemetry aggregator when one is bound, else the master's own
+        obs dir."""
+        import json
+
+        from elasticdl_tpu.observability import profiling
+
+        seconds = request.seconds or 2.0
+        endpoints = self._profile_endpoints()
+        if request.role_prefix:
+            endpoints = [
+                e
+                for e in endpoints
+                if e.get("role", "").startswith(request.role_prefix)
+            ]
+        results = profiling.fanout_profiles(endpoints, seconds)
+        captured = sum(
+            1 for r in results.values() if "error" not in r
+        )
+        logger.info(
+            "Profile fan-out: %d/%d captures ok (%.1fs)",
+            captured, len(results), seconds,
+        )
+        return pb.StartProfileResponse(
+            captured=captured, results_json=json.dumps(results)
+        )
+
+    def _profile_endpoints(self):
+        if self._aggregator is not None:
+            return self._aggregator.discover_endpoints()
+        from elasticdl_tpu import observability
+        from elasticdl_tpu.observability.aggregator import (
+            read_endpoints,
+        )
+
+        handle = observability.current_handle()
+        if handle is None or not handle.obs_dir:
+            return []
+        return read_endpoints(os.path.join(handle.obs_dir, "endpoints"))

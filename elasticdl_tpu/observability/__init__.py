@@ -43,7 +43,7 @@ class ObservabilityHandle:
     """One process's configured observability plane."""
 
     def __init__(self, role, job, obs_dir, exporter, recorder, event_log,
-                 flight=None):
+                 flight=None, memory=None):
         self.role = role
         self.job = job
         self.obs_dir = obs_dir
@@ -51,6 +51,7 @@ class ObservabilityHandle:
         self.recorder = recorder
         self.event_log = event_log
         self.flight = flight
+        self.memory = memory
 
     @property
     def metrics_port(self):
@@ -63,8 +64,31 @@ class ObservabilityHandle:
 
             if flightrec.get() is self.flight:
                 flightrec.uninstall()
+        if self.memory is not None:
+            self.memory.close()
         if self.exporter is not None:
             self.exporter.close()
+            # Clean shutdown withdraws the endpoint advertisement so the
+            # master's aggregator stops scraping a port nobody serves
+            # (crashed pods are handled by its stale-endpoint counter).
+            # Only when the file is still OURS: a relaunched successor
+            # with the same role may have rewritten it, and deleting the
+            # live advert would silently unplug that process. (A
+            # microsecond read-then-remove window remains — POSIX has no
+            # compare-and-unlink — accepted: the successor would have to
+            # advertise inside it, and the failure needs BOTH processes
+            # shutting down/starting in that instant.)
+            if self.obs_dir:
+                path = os.path.join(
+                    self.obs_dir, "endpoints", f"{self.role}.json"
+                )
+                try:
+                    with open(path) as f:
+                        advertised = json.load(f)
+                    if advertised.get("pid") == os.getpid():
+                        os.remove(path)
+                except (OSError, ValueError):
+                    pass
         if self.recorder is not None:
             self.recorder.close()
             if _tracing.get_recorder() is self.recorder:
@@ -91,6 +115,12 @@ def setup(role, job="", obs_dir=None, metrics_port=None, registry=None):
     disables the endpoint. The bound endpoint is advertised under
     <obs_dir>/endpoints/<role>.json so monitors and tests can find every
     process of a job without guessing ports.
+
+    Idempotent: a second setup() in the same process returns the first
+    call's live handle unchanged (double wiring would double-register
+    exporters and samplers). Port-collision-safe: a fixed metrics_port
+    that is already bound falls back to an ephemeral port and the
+    advertisement carries whatever port actually bound.
     """
     global _handle
     if _handle is not None:
@@ -137,17 +167,46 @@ def setup(role, job="", obs_dir=None, metrics_port=None, registry=None):
                 registry or default_registry(), port=metrics_port
             )
         except OSError:
-            # A busy fixed port must not kill a training process; the
-            # metrics stay collectable in-process (and via the next
-            # relaunch, which may land on a free port).
+            # A busy fixed port must not kill (or silence) a training
+            # process: fall back to an ephemeral port and re-advertise —
+            # scrapers find endpoints through the advertisement file,
+            # not the configured number.
             log_utils.get_logger("observability").warning(
-                "Could not bind metrics endpoint on port %d", metrics_port
+                "Could not bind metrics endpoint on port %d; falling "
+                "back to an ephemeral port", metrics_port,
             )
+            try:
+                exporter = MetricsExporter(
+                    registry or default_registry(), port=0
+                )
+            except OSError:
+                log_utils.get_logger("observability").warning(
+                    "Could not bind any metrics endpoint; metrics stay "
+                    "in-process only"
+                )
+    if exporter is not None:
+        # On-demand device profiling for this role: every exporter
+        # answers /debug/profile, capturing into <obs_dir>/profiles/
+        # (or ./profiles without an obs dir).
+        from elasticdl_tpu.observability import profiling
+
+        exporter.profile_provider = profiling.profile_provider(
+            obs_dir, role
+        )
     if obs_dir and exporter is not None:
         _advertise_endpoint(obs_dir, role, job, exporter.port)
 
+    # Memory accountant: live/peak device + host RSS gauges and
+    # high-watermark events, sampled on a daemon thread
+    # (ELASTICDL_MEM_SAMPLE_SECONDS=0 disables the thread; the
+    # process-global accountant still answers direct sample() calls).
+    from elasticdl_tpu.observability import memory as _memory
+
+    mem = _memory.accountant().start()
+
     _handle = ObservabilityHandle(
-        role, job, obs_dir, exporter, recorder, event_log, flight
+        role, job, obs_dir, exporter, recorder, event_log, flight,
+        memory=mem,
     )
     return _handle
 

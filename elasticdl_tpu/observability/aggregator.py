@@ -57,6 +57,29 @@ _EWMA_ALPHA = 0.3
 # straggler scoring: one slow compile must not flag a healthy worker.
 MIN_STEP_SAMPLES = 3
 
+STALE_SCRAPES_ENV = "ELASTICDL_ENDPOINT_STALE_SCRAPES"
+
+
+def read_endpoints(endpoints_dir):
+    """Parse every advertisement under one endpoints/ dir (shared with
+    the master's StartProfile fan-out)."""
+    endpoints = []
+    try:
+        entries = os.listdir(endpoints_dir)
+    except OSError:
+        return endpoints
+    for entry in sorted(entries):
+        if not entry.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(endpoints_dir, entry)) as f:
+                info = json.load(f)
+        except (OSError, ValueError):
+            continue  # mid-rewrite; next pass sees it whole
+        if info.get("port"):
+            endpoints.append(info)
+    return endpoints
+
 
 class SeriesStore:
     """Bounded (role, metric, labels) -> deque[(ts, value)] store."""
@@ -208,6 +231,13 @@ class TelemetryAggregator:
         self._summary = {"job": job, "ts": None}
         self._ewma = {}  # worker role -> EWMA step seconds
         self._gauged_workers = set()  # roles with exported per-worker gauges
+        # (role, pid, port) -> consecutive scrape failures; at
+        # _stale_after the endpoint is dropped until its advertisement
+        # is rewritten (relaunch) or withdrawn (clean shutdown).
+        self._scrape_failures = {}
+        self._stale_after = max(
+            1, knobs.get_int(STALE_SCRAPES_ENV)
+        )
         self._throughput_history = collections.deque(maxlen=60)
         self._stop = threading.Event()
         self._thread = None
@@ -270,6 +300,22 @@ class TelemetryAggregator:
             "Aggregator scrapes that failed (endpoint mid-restart, ...)",
             labelnames=("role",),
         )
+        self._g_stale = reg.gauge(
+            "edl_job_endpoints_stale",
+            "Advertised endpoints dropped after consecutive scrape "
+            "failures (dead pods whose advertisement file survived)",
+        )
+        self._g_compiles = reg.gauge(
+            "edl_job_compiles",
+            "Tracked step-function compiles summed across all scraped "
+            "roles, by attributed cause",
+            labelnames=("cause",),
+        )
+        self._g_compile_seconds = reg.gauge(
+            "edl_job_compile_seconds",
+            "Seconds spent compiling tracked step functions, summed "
+            "across all scraped roles",
+        )
 
     # ---------- lifecycle ----------
 
@@ -296,23 +342,25 @@ class TelemetryAggregator:
 
     # ---------- scraping ----------
 
-    def _discover_endpoints(self):
-        endpoints = []
-        try:
-            entries = os.listdir(self._endpoints_dir)
-        except OSError:
-            return endpoints
-        for entry in sorted(entries):
-            if not entry.endswith(".json"):
-                continue
-            try:
-                with open(os.path.join(self._endpoints_dir, entry)) as f:
-                    info = json.load(f)
-            except (OSError, ValueError):
-                continue  # mid-rewrite; next pass sees it whole
-            if info.get("port"):
-                endpoints.append(info)
-        return endpoints
+    def discover_endpoints(self):
+        """Live endpoint advertisements (the StartProfile fan-out reads
+        this too; stale-skipped endpoints are excluded)."""
+        return [
+            info
+            for info in read_endpoints(self._endpoints_dir)
+            if not self._is_stale(info)
+        ]
+
+    def _endpoint_key(self, info):
+        # A relaunch rewrites the advertisement with a new pid/port —
+        # that is a NEW endpoint and must reset the failure count.
+        return (info.get("role", ""), info.get("pid"), info.get("port"))
+
+    def _is_stale(self, info):
+        return (
+            self._scrape_failures.get(self._endpoint_key(info), 0)
+            >= self._stale_after
+        )
 
     def _scrape(self, info):
         host = info.get("host") or "127.0.0.1"
@@ -332,19 +380,47 @@ class TelemetryAggregator:
         denominators of everyone scraped after it."""
         live = now is None
         scraped = set()
-        for info in self._discover_endpoints():
+        stale = 0
+        live_keys = set()
+        for info in read_endpoints(self._endpoints_dir):
             role = info.get("role", "")
             if role == "master" and info.get("pid") == os.getpid():
                 continue  # own registry is read in-process below
+            key = self._endpoint_key(info)
+            live_keys.add(key)
+            if self._is_stale(info):
+                # Dead pod whose advertisement survived (SIGKILL skips
+                # the clean-shutdown removal): stop hammering the port.
+                stale += 1
+                continue
             try:
                 text = self._scrape(info)
             except (OSError, ValueError):
                 self._c_scrape_errors.labels(role=role or "?").inc()
+                self._scrape_failures[key] = (
+                    self._scrape_failures.get(key, 0) + 1
+                )
+                if self._is_stale(info):
+                    stale += 1
+                    logger.warning(
+                        "Endpoint %s (pid %s, port %s) failed %d "
+                        "consecutive scrapes; dropping it until its "
+                        "advertisement is rewritten",
+                        role, info.get("pid"), info.get("port"),
+                        self._stale_after,
+                    )
                 continue
+            self._scrape_failures.pop(key, None)
             ts = time.time() if live else now
             if self._ingest(role, text, ts):
                 scraped.add(role)
                 self._c_scrapes.labels(role=role or "?").inc()
+        # Forget failure counts of withdrawn/rewritten advertisements so
+        # the map stays bounded by the live endpoint set.
+        for key in list(self._scrape_failures):
+            if key not in live_keys:
+                del self._scrape_failures[key]
+        self._g_stale.set(stale)
         # The master's own registry never travels over HTTP: reading it
         # in-process keeps master-side signals alive even when its
         # exporter could not bind a port.
@@ -519,6 +595,34 @@ class TelemetryAggregator:
             "master", "edl_tasks_recovered_total"
         )
 
+        # --- compile accounting (the profiling plane, aggregated) ---
+        # Sum the per-role edl_compile_* counters over EVERY scraped
+        # role so one master scrape answers "how much recompiling did
+        # this elastic job do, and why".
+        compile_counts = {}  # cause -> count
+        compile_seconds = 0.0
+        for role in self.store.roles():
+            for labels in self.store.labelsets(role, "edl_compile_total"):
+                value = self.store.latest(
+                    role, "edl_compile_total", labels
+                )
+                if value:
+                    cause = dict(labels).get("cause", "?")
+                    compile_counts[cause] = (
+                        compile_counts.get(cause, 0) + value
+                    )
+            for labels in self.store.labelsets(
+                role, "edl_compile_seconds_total"
+            ):
+                value = self.store.latest(
+                    role, "edl_compile_seconds_total", labels
+                )
+                if value:
+                    compile_seconds += value
+        for cause, count in compile_counts.items():
+            self._g_compiles.labels(cause=cause).set(count)
+        self._g_compile_seconds.set(compile_seconds)
+
         # --- alerts ---
         signals = {
             "records_per_second": rps,
@@ -576,6 +680,11 @@ class TelemetryAggregator:
             "alerts_fired": self.engine.fired_total,
             "membership_epoch": membership_epoch,
             "roles_scraped": sorted(scraped),
+            "compiles": {
+                "total": sum(compile_counts.values()),
+                "by_cause": compile_counts,
+                "edl_compile_seconds_total": round(compile_seconds, 4),
+            },
         }
         with self._lock:
             self._summary = summary

@@ -16,23 +16,46 @@ Event kinds (docs/OBSERVABILITY.md#event-schema):
   lease_mint / lease_grant / lease_report / lease_abort / lease_complete
   task_create / task_timeout / task_reassign / task_failed / job_failed
   worker_removed / membership_epoch
+  compile / mem_high_watermark / profile_start / profile_done / rotated
 """
 
 import json
-import os
 import threading
 import time
 
+from elasticdl_tpu.observability.rotation import SizeCappedFile
+
 
 class EventLog:
-    def __init__(self, path, job="", role=""):
+    def __init__(self, path, job="", role="", max_bytes=None):
         self.path = path
         self._job = job
         self._role = role
         self._lock = threading.Lock()
         self._seq = 0
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self._file = open(path, "a", buffering=1)
+        # Size-capped: the previous generation survives as <path>.1 and
+        # every fresh generation opens with a `rotated` marker event so
+        # readers see a deliberate cut, not a gap.
+        self._file = SizeCappedFile(
+            path, max_bytes=max_bytes, on_rotate=self._write_rotated_marker_locked
+        )
+
+    def _write_rotated_marker_locked(self, generation):
+        # Called under self._lock, mid-write, right after the rename:
+        # this marker is the new file's first record.
+        self._seq += 1
+        self._file.append_line(
+            json.dumps(
+                {
+                    "ts": time.time(),
+                    "kind": "rotated",
+                    "role": self._role,
+                    "generation": generation,
+                    "seq": self._seq,
+                },
+                separators=(",", ":"),
+            )
+        )
 
     def emit(self, kind, **fields):
         record = {"ts": time.time(), "kind": kind}
@@ -44,10 +67,16 @@ class EventLog:
         with self._lock:
             if self._file.closed:
                 return
+            # Rotation check BEFORE assigning seq: a rotation writes the
+            # marker (which takes the next seq) as the new generation's
+            # first record, so seq stays monotonic in file order. The
+            # +24 covers the seq field this record is about to gain.
+            probe = json.dumps(record, separators=(",", ":"))
+            self._file.maybe_rotate(len(probe) + 24)
             self._seq += 1
             record["seq"] = self._seq
-            self._file.write(
-                json.dumps(record, separators=(",", ":")) + "\n"
+            self._file.append_line(
+                json.dumps(record, separators=(",", ":"))
             )
 
     def close(self):

@@ -2,10 +2,13 @@
 
 One daemonized ThreadingHTTPServer per process serving:
 
-    /metrics       the registry in text-exposition format
-    /healthz       "ok" — a liveness probe target for k8s pod specs
-    /api/summary   job-level JSON summary (master only — present when a
-                   TelemetryAggregator installed a summary provider)
+    /metrics        the registry in text-exposition format
+    /healthz        "ok" — a liveness probe target for k8s pod specs
+    /api/summary    job-level JSON summary (master only — present when a
+                    TelemetryAggregator installed a summary provider)
+    /debug/profile  on-demand jax.profiler capture of this process
+                    (?seconds=N; present when observability.setup()
+                    installed a profile provider)
 
 GET and HEAD are both answered (k8s http probes default to HEAD; a 501
 there flaps the pod). No third-party dependency: the exposition format is
@@ -20,6 +23,7 @@ advertisement written by observability.setup().
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from elasticdl_tpu.common import knobs
@@ -60,6 +64,38 @@ class _Handler(BaseHTTPRequestHandler):
                 self.send_error(500)
                 return
             self._respond(200, body, "application/json", send_body)
+        elif path == "/debug/profile":
+            # On-demand jax.profiler capture of THIS process
+            # (?seconds=N, default 2): blocks the requesting connection
+            # for the capture duration — the server is threaded, so
+            # concurrent /metrics scrapes keep answering. 409 when a
+            # capture is already running, 404 when the role has no
+            # provider (observability.setup() not run).
+            provider = getattr(self.exporter, "profile_provider", None)
+            if provider is None:
+                self.send_error(404)
+                return
+            if not send_body:
+                # A HEAD must not burn an N-second capture (and a
+                # profile directory) just to answer headers.
+                self.send_error(405)
+                return
+            query = urllib.parse.parse_qs(
+                urllib.parse.urlparse(self.path).query
+            )
+            try:
+                seconds = float(query.get("seconds", ["2.0"])[0])
+            except ValueError:
+                seconds = 2.0
+            try:
+                body = json.dumps(provider(seconds)).encode()
+            except RuntimeError:
+                self.send_error(409)  # capture already in flight
+                return
+            except Exception:
+                self.send_error(500)
+                return
+            self._respond(200, body, "application/json", send_body)
         else:
             self.send_error(404)
 
@@ -82,6 +118,9 @@ class MetricsExporter:
         # Installed post-construction by the master's TelemetryAggregator;
         # callable returning a JSON-able dict for /api/summary.
         self.summary_provider = None
+        # Installed by observability.setup(): callable(seconds) -> dict
+        # backing /debug/profile (on-demand jax.profiler capture).
+        self.profile_provider = None
         handler = type(
             "_BoundHandler",
             (_Handler,),

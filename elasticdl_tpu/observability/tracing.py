@@ -125,32 +125,61 @@ def role_pid(role):
 
 
 class SpanRecorder:
-    """Appends Chrome-trace events to a JSONL file; thread-safe."""
+    """Appends Chrome-trace events to a JSONL file; thread-safe.
+    Size-capped (observability/rotation.py): a rotated generation keeps
+    the previous cap's worth of spans as <path>.1 and re-stamps the
+    process-name metadata plus a `rotated` marker so the fresh file is
+    independently loadable in Perfetto."""
 
-    def __init__(self, path, process_name):
+    def __init__(self, path, process_name, max_bytes=None):
+        from elasticdl_tpu.observability.rotation import SizeCappedFile
+
         self.path = path
         self.process_name = process_name
         self.pid = role_pid(process_name)
         self._lock = threading.Lock()
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self._file = open(path, "a", buffering=1)
+        self._file = SizeCappedFile(
+            path, max_bytes=max_bytes, on_rotate=self._on_rotate
+        )
         # Perfetto reads process names from this metadata event.
-        self._write(
+        self._write(self._process_meta())
+
+    def _process_meta(self):
+        return {
+            "ph": "M",
+            "name": "process_name",
+            "pid": self.pid,
+            "tid": 0,
+            "args": {"name": self.process_name},
+        }
+
+    def _on_rotate(self, generation):
+        # Runs under self._lock mid-write (rotation.py callback): these
+        # are the new generation's first lines.
+        for event in (
+            self._process_meta(),
             {
-                "ph": "M",
-                "name": "process_name",
+                "ph": "i",
+                "s": "p",
+                "name": "rotated",
+                "cat": "edl",
+                "ts": round(time.time() * 1e6, 1),
                 "pid": self.pid,
                 "tid": 0,
-                "args": {"name": process_name},
-            }
-        )
+                "args": {"generation": generation},
+            },
+        ):
+            self._file.append_line(
+                json.dumps(event, separators=(",", ":"))
+            )
 
     def _write(self, event):
         line = json.dumps(event, separators=(",", ":"))
         with self._lock:
             if self._file.closed:
                 return
-            self._file.write(line + "\n")
+            self._file.write_line(line)
 
     def record(self, name, start_s, dur_s, cat="edl", args=None):
         """One complete span; times in seconds (perf-epoch: time.time)."""
@@ -221,6 +250,16 @@ def span(name, cat="edl", **args):
         if rec is not None:
             rec.record(name, start, dur, cat=cat, args=args)
         _feed_sinks(name, start, dur, cat, args)
+
+
+def record_span(name, start_s, dur_s, cat="edl", args=None):
+    """Record an already-measured span (recorder + sinks). For callers
+    that time the interval themselves — e.g. the compile tracker, which
+    only knows a call was a compile once it returns."""
+    rec = _recorder
+    if rec is not None:
+        rec.record(name, start_s, dur_s, cat=cat, args=args)
+    _feed_sinks(name, start_s, dur_s, cat, args)
 
 
 def instant(name, cat="edl", **args):

@@ -80,6 +80,15 @@ class ParameterServer:
         )
         logger.info("PS %d/%d serving on port %d", ps_id, num_ps, self.port)
         self._stop_event = threading.Event()
+        # Memory accounting: this shard's embedding-table / dense-param
+        # byte counts become edl_mem_component_bytes{component=...} so a
+        # hot shard's RSS is attributable to the table that causes it.
+        from elasticdl_tpu.observability import memory as _memory
+
+        self._mem_provider = _memory.embedding_bytes_provider(
+            self.parameters
+        )
+        _memory.accountant().add_provider(self._mem_provider)
 
     @property
     def addr(self):
@@ -103,3 +112,6 @@ class ParameterServer:
     def stop(self):
         self._stop_event.set()
         self._server.stop(0)
+        from elasticdl_tpu.observability import memory as _memory
+
+        _memory.accountant().remove_provider(self._mem_provider)

@@ -368,6 +368,15 @@ class AllReduceTrainer(JaxTrainer):
             )
         self._mesh = self._make_world_mesh()
         logger.info("Mesh axes: %s", dict(self._mesh.shape))
+        # Stamp the new world's fingerprint BEFORE any step rebuild: the
+        # compile tracker attributes the re-lowerings that follow to
+        # this regroup (cause=mesh_change) instead of to shape drift.
+        from elasticdl_tpu.observability import profiling
+
+        profiling.note_mesh(
+            f"epoch{resp.rendezvous_id}:{dict(self._mesh.shape)}",
+            world_size=resp.world_size,
+        )
         self._sharded_steps = {}
         self._local_forward = None  # compiled against the torn-down backend
         self._rebuild_pipeline_build()
@@ -956,8 +965,12 @@ class AllReduceTrainer(JaxTrainer):
                 if self._tp_active() or self._pp_active()
                 else self._opt_placement(self._opt_state)
             )
-            step = jax.jit(
+            from elasticdl_tpu.observability.profiling import tracked_jit
+
+            step = tracked_jit(
                 step_fn,
+                name="allreduce_step",
+                key_argnums=(3, 4),
                 in_shardings=(var_sh, opt_sh, repl, data, data),
                 out_shardings=(var_sh, opt_sh, repl),
             )
@@ -1116,6 +1129,8 @@ class AllReduceTrainer(JaxTrainer):
 
     def _build_forward(self):
         if self._pipeline_build is not None:
+            from elasticdl_tpu.observability.profiling import tracked_jit
+
             apply_fn = self._pipeline_build.apply_fn
 
             def forward(variables, features):
@@ -1123,7 +1138,9 @@ class AllReduceTrainer(JaxTrainer):
                     variables["params"], features, training=False
                 )
 
-            return jax.jit(forward)
+            return tracked_jit(
+                forward, name="pipeline_forward", key_argnums=(1,)
+            )
         return super()._build_forward()
 
     # ---------- Trainer interface ----------
@@ -1269,16 +1286,22 @@ class AllReduceTrainer(JaxTrainer):
                 host_vars = jax.device_get(self._variables)
                 self._eval_host_cache = (key, host_vars)
         if self._local_forward is None:
+            from elasticdl_tpu.observability.profiling import tracked_jit
+
             if self._pipeline_build is not None:
                 apply_fn = self._pipeline_build.apply_fn
-                self._local_forward = jax.jit(
+                self._local_forward = tracked_jit(
                     lambda v, f: apply_fn(
                         v["params"], f, training=False
-                    )
+                    ),
+                    name="allreduce_local_forward",
+                    key_argnums=(1,),
                 )
             else:
-                self._local_forward = jax.jit(
-                    lambda v, f: self._model.apply(v, f, training=False)
+                self._local_forward = tracked_jit(
+                    lambda v, f: self._model.apply(v, f, training=False),
+                    name="allreduce_local_forward",
+                    key_argnums=(1,),
                 )
         outputs = self._local_forward(
             host_vars, jax.tree_util.tree_map(np.asarray, features)

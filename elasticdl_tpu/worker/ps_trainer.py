@@ -260,6 +260,8 @@ class ParameterServerTrainer(JaxTrainer):
         doesn't need a pull. The PS still owns the truth — the next pull
         overwrites any local drift."""
         if self._local_step is None:
+            from elasticdl_tpu.observability.profiling import tracked_jit
+
             def apply(params, opt_state, grads):
                 updates, opt_state = self._optax.update(
                     grads, opt_state, params
@@ -268,7 +270,12 @@ class ParameterServerTrainer(JaxTrainer):
 
                 return _optax.apply_updates(params, updates), opt_state
 
-            self._local_step = jax.jit(apply)
+            # key_argnums=(): params/opt_state/grads shapes are static
+            # after init, and hashing three full trees per step is the
+            # cost the train-step key deliberately avoids.
+            self._local_step = tracked_jit(
+                apply, name="ps_local_apply", key_argnums=()
+            )
         self._variables["params"], self._opt_state = self._local_step(
             self._variables["params"], self._opt_state, param_grads
         )
@@ -400,9 +407,15 @@ class ParameterServerTrainer(JaxTrainer):
             )(params, emb_rows)
             return loss, grads[0], grads[1], new_state
 
-        return jax.jit(step)
+        # Keyed on (emb_rows, features, labels): per-batch embedding row
+        # counts are the shape axis that actually varies in PS mode.
+        from elasticdl_tpu.observability.profiling import tracked_jit
+
+        return tracked_jit(step, name="ps_step", key_argnums=(2, 4, 5))
 
     def _build_ps_forward(self):
+        from elasticdl_tpu.observability.profiling import tracked_jit
+
         def forward(params, state, emb_rows, features):
             return self._model.apply(
                 {
@@ -414,7 +427,9 @@ class ParameterServerTrainer(JaxTrainer):
                 training=False,
             )
 
-        return jax.jit(forward)
+        return tracked_jit(
+            forward, name="ps_forward", key_argnums=(2, 3)
+        )
 
     # ---------- Trainer interface ----------
 

@@ -182,13 +182,26 @@ class JaxTrainer(Trainer):
         return {"params": new_params, **new_state}, new_opt_state, loss
 
     def _build_train_step(self):
-        return jax.jit(self._step_body, donate_argnums=(0, 1))
+        # tracked_jit (observability/profiling.py): every lowering is
+        # counted/timed with its cause attributed (cold / shape_change /
+        # mesh_change / donation_miss). key_argnums keeps the hot-path
+        # shape signature on the batch — param shapes are static after
+        # init, and flattening the full tree per step is the cost the
+        # MFU cache already refused to pay.
+        from elasticdl_tpu.observability.profiling import tracked_jit
+
+        return tracked_jit(
+            self._step_body, name="train_step", key_argnums=(3, 4),
+            donate_argnums=(0, 1),
+        )
 
     def _build_forward(self):
+        from elasticdl_tpu.observability.profiling import tracked_jit
+
         def forward(variables, features):
             return self._model.apply(variables, features, training=False)
 
-        return jax.jit(forward)
+        return tracked_jit(forward, name="forward", key_argnums=(1,))
 
     # ---------- Trainer interface ----------
 

@@ -635,3 +635,95 @@ def test_cli_list_rules_covers_all_families(capsys):
     out = capsys.readouterr().out
     for cls in ALL_RULES:
         assert cls.name in out
+
+
+# ---------------------------------------------------------------------------
+# compile-tracker
+# ---------------------------------------------------------------------------
+
+
+def test_compile_tracker_flags_direct_jit_in_trainer_paths(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "elasticdl_tpu/worker/untracked.py": """
+            import jax
+            from jax.experimental.pjit import pjit
+
+            def build(step):
+                a = jax.jit(step)
+                b = pjit(step)
+                return a, b
+            """,
+        },
+    )
+    got = keys(run_rule(project, "compile-tracker"))
+    assert "direct-jit:jax.jit" in got
+    assert any(k.endswith("pjit") for k in got), got
+
+
+def test_compile_tracker_allows_tracked_and_out_of_scope(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            # tracked_jit is the sanctioned entrypoint; shard_map is not
+            # a compile boundary on its own.
+            "elasticdl_tpu/worker/tracked.py": """
+            from elasticdl_tpu.observability.profiling import tracked_jit
+            from elasticdl_tpu.common.jax_compat import shard_map
+
+            def build(step, mesh):
+                inner = shard_map(step, mesh=mesh)
+                return tracked_jit(inner, name="step")
+            """,
+            # observability/ itself (and anywhere outside worker/
+            # parallel/ps) may jit directly — mfu's AOT analysis, tests.
+            "elasticdl_tpu/observability/free.py": """
+            import jax
+
+            analyze = jax.jit(lambda x: x)
+            """,
+        },
+    )
+    assert run_rule(project, "compile-tracker") == []
+
+
+def test_compile_tracker_suppression(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "elasticdl_tpu/ps/special.py": """
+            import jax
+
+            def build(step):
+                # edl-lint: disable=compile-tracker
+                return jax.jit(step)
+            """,
+        },
+    )
+    assert run_rule(project, "compile-tracker") == []
+
+
+def test_jit_purity_covers_tracked_jit(tmp_path):
+    """Moving trainers to tracked_jit must not remove them from the
+    purity analysis — the wrapped function is traced all the same."""
+    project = make_project(
+        tmp_path,
+        {
+            "elasticdl_tpu/worker/tracked_impure.py": """
+            import time
+            from elasticdl_tpu.observability.profiling import tracked_jit
+
+            class T:
+                def _step(self, x):
+                    time.time()
+                    return x
+
+                def build(self):
+                    return tracked_jit(self._step, name="step")
+            """,
+        },
+    )
+    assert "_step:time:time.time" in keys(
+        run_rule(project, "jit-purity")
+    )

@@ -1,0 +1,201 @@
+"""Observability-plane hardening: size-capped log rotation, idempotent +
+port-collision-safe setup(), endpoint advertisement lifecycle, and the
+aggregator's stale-endpoint drop."""
+
+import json
+import os
+import socket
+import time
+
+from elasticdl_tpu import observability
+from elasticdl_tpu.observability import events as obs_events
+from elasticdl_tpu.observability import tracing
+from elasticdl_tpu.observability.aggregator import TelemetryAggregator
+from elasticdl_tpu.observability.metrics import MetricsRegistry
+from elasticdl_tpu.observability.rotation import SizeCappedFile
+
+
+# ---------------------------------------------------------------------------
+# rotation
+# ---------------------------------------------------------------------------
+
+
+def test_size_capped_file_bounds_disk(tmp_path):
+    path = str(tmp_path / "log.jsonl")
+    f = SizeCappedFile(path, max_bytes=1024)
+    line = "x" * 99
+    for _ in range(200):  # ~20 KB through a 1 KB cap
+        f.write_line(line)
+    f.close()
+    live = os.path.getsize(path)
+    prev = os.path.getsize(path + ".1")
+    assert live <= 1024
+    assert prev <= 1024 + 100  # one record of slack at rotation time
+    assert f.rotations >= 10
+    # The newest records survive in the live file.
+    assert open(path).read().splitlines()[-1] == line
+
+
+def test_event_log_rotation_emits_marker(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = obs_events.EventLog(path, job="j", role="r", max_bytes=2048)
+    for i in range(200):
+        log.emit("task_create", padding="p" * 64, i=i)
+    log.close()
+    events = obs_events.read_events(path)
+    # Each fresh generation opens with the rotated marker.
+    assert events[0]["kind"] == "rotated"
+    assert events[0]["generation"] >= 1
+    # seq stays monotonic across the cut (marker included).
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs)
+    assert os.path.getsize(path) <= 2048
+    assert os.path.exists(path + ".1")
+
+
+def test_trace_rotation_restamps_process_meta(tmp_path):
+    path = str(tmp_path / "trace_test.jsonl")
+    rec = tracing.SpanRecorder(path, "job/test", max_bytes=2048)
+    for i in range(100):
+        rec.record("span_" + "x" * 64, time.time(), 0.001)
+    rec.close()
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    # First line of the rotated generation: Perfetto process metadata,
+    # then the rotated marker — the file loads standalone.
+    assert lines[0]["ph"] == "M"
+    assert lines[0]["args"]["name"] == "job/test"
+    assert lines[1]["name"] == "rotated"
+    assert os.path.getsize(path) <= 2048
+
+
+def test_rotation_disabled_by_zero_cap(tmp_path):
+    f = SizeCappedFile(str(tmp_path / "log"), max_bytes=0)
+    for _ in range(50):
+        f.write_line("y" * 100)
+    f.close()
+    assert f.rotations == 0
+    assert not os.path.exists(str(tmp_path / "log") + ".1")
+
+
+# ---------------------------------------------------------------------------
+# setup(): idempotence, port collision, advertisement lifecycle
+# ---------------------------------------------------------------------------
+
+
+def _read_advert(obs_dir, role):
+    with open(os.path.join(obs_dir, "endpoints", f"{role}.json")) as f:
+        return json.load(f)
+
+
+def test_setup_idempotent_and_advert_removed_on_close(tmp_path, monkeypatch):
+    monkeypatch.setenv("ELASTICDL_METRICS_HOST", "127.0.0.1")
+    monkeypatch.setenv("ELASTICDL_MEM_SAMPLE_SECONDS", "0")
+    handle = observability.setup(
+        role="testrole", job="j", obs_dir=str(tmp_path), metrics_port=0
+    )
+    try:
+        # Second setup returns the SAME live handle — no double wiring.
+        again = observability.setup(
+            role="other", job="j2", obs_dir=str(tmp_path)
+        )
+        assert again is handle
+        advert = _read_advert(str(tmp_path), "testrole")
+        assert advert["port"] == handle.metrics_port > 0
+    finally:
+        handle.close()
+    # Clean shutdown withdraws the advertisement.
+    assert not os.path.exists(
+        os.path.join(str(tmp_path), "endpoints", "testrole.json")
+    )
+    assert observability.current_handle() is None
+
+
+def test_setup_falls_back_to_ephemeral_port_on_collision(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv("ELASTICDL_METRICS_HOST", "127.0.0.1")
+    monkeypatch.setenv("ELASTICDL_MEM_SAMPLE_SECONDS", "0")
+    squatter = socket.socket()
+    squatter.bind(("127.0.0.1", 0))
+    squatter.listen(1)
+    busy_port = squatter.getsockname()[1]
+    try:
+        handle = observability.setup(
+            role="collide",
+            job="j",
+            obs_dir=str(tmp_path),
+            metrics_port=busy_port,
+        )
+        try:
+            assert handle.exporter is not None
+            assert handle.metrics_port not in (0, busy_port)
+            # The advertisement carries the port that actually bound.
+            advert = _read_advert(str(tmp_path), "collide")
+            assert advert["port"] == handle.metrics_port
+        finally:
+            handle.close()
+    finally:
+        squatter.close()
+
+
+# ---------------------------------------------------------------------------
+# aggregator: stale endpoints
+# ---------------------------------------------------------------------------
+
+
+def _write_advert(obs_dir, role, port, pid=4242):
+    endpoints = os.path.join(obs_dir, "endpoints")
+    os.makedirs(endpoints, exist_ok=True)
+    with open(os.path.join(endpoints, f"{role}.json"), "w") as f:
+        json.dump(
+            {"role": role, "job": "j", "pid": pid, "port": port,
+             "host": "127.0.0.1"},
+            f,
+        )
+
+
+def _dead_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_aggregator_drops_endpoint_after_consecutive_failures(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv("ELASTICDL_ENDPOINT_STALE_SCRAPES", "3")
+    _write_advert(str(tmp_path), "worker-9", _dead_port())
+    agg = TelemetryAggregator(
+        obs_dir=str(tmp_path),
+        registry=MetricsRegistry(),
+        job="j",
+        interval=60,
+        scrape_timeout=0.2,
+    )
+    for _ in range(3):
+        agg.poll_once()
+    # Dropped: excluded from discovery, counted in the stale gauge.
+    assert agg.discover_endpoints() == []
+    assert agg._registry.get("edl_job_endpoints_stale").value == 1
+    errors = agg._registry.get("edl_job_scrape_errors_total")
+    assert errors.labels(role="worker-9").value == 3
+    # Another pass must NOT scrape it again (error count frozen).
+    agg.poll_once()
+    assert errors.labels(role="worker-9").value == 3
+
+    # A relaunch rewrites the advertisement (new pid): counter resets,
+    # endpoint scrapes again.
+    _write_advert(str(tmp_path), "worker-9", _dead_port(), pid=4243)
+    agg.poll_once()
+    assert errors.labels(role="worker-9").value == 4
+    assert len(agg.discover_endpoints()) == 1
+
+    # A withdrawn advertisement clears its failure bookkeeping.
+    os.remove(
+        os.path.join(str(tmp_path), "endpoints", "worker-9.json")
+    )
+    agg.poll_once()
+    assert agg._scrape_failures == {}
+    assert agg._registry.get("edl_job_endpoints_stale").value == 0

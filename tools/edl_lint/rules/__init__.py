@@ -1,5 +1,6 @@
 """Rule registry: every rule module registers its Rule subclass here."""
 
+from tools.edl_lint.rules.compile_tracker import CompileTrackerRule
 from tools.edl_lint.rules.concurrency import ConcurrencyRule
 from tools.edl_lint.rules.dead_code import DeadCodeRule
 from tools.edl_lint.rules.env_knobs import EnvKnobsRule
@@ -11,6 +12,7 @@ from tools.edl_lint.rules.rpc_deadlines import RpcDeadlinesRule
 ALL_RULES = (
     ConcurrencyRule,
     JitPurityRule,
+    CompileTrackerRule,
     EnvKnobsRule,
     ProtoDriftRule,
     RpcDeadlinesRule,

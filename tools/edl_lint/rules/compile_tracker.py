@@ -1,0 +1,70 @@
+"""compile-tracker: step lowerings in trainer paths go through the
+tracker.
+
+The deep-profiling plane's compile accounting
+(elasticdl_tpu/observability/profiling.py) only sees lowerings that go
+through `tracked_jit`. A direct `jax.jit`/`pjit` call in worker/,
+parallel/, or ps/ builds an executable whose recompiles are invisible —
+exactly the blind spot the tracker exists to close, and the first thing
+the mesh/ZeRO unification arc would silently reopen. This rule flags
+every such call site; `shard_map` is exempt (it is not a compile entry
+on its own — the jit wrapping it is the tracked boundary).
+"""
+
+import ast
+import os
+
+from tools.edl_lint.core import Finding, Rule
+
+_SCOPE = (
+    "elasticdl_tpu/worker/",
+    "elasticdl_tpu/parallel/",
+    "elasticdl_tpu/ps/",
+)
+
+_ENTRY_TAILS = {"jit", "pjit"}
+_TRACKED = {"tracked_jit"}
+
+
+def _is_direct_jit(dotted):
+    if not dotted:
+        return False
+    tail = dotted.rsplit(".", 1)[-1]
+    if tail in _TRACKED:
+        return False
+    if tail not in _ENTRY_TAILS:
+        return False
+    # jax.jit / jax.experimental.pjit.pjit / bare jit-from-jax imports;
+    # profiling.tracked_jit resolves to its own tail above.
+    return "jax" in dotted or dotted == tail
+
+
+class CompileTrackerRule(Rule):
+    name = "compile-tracker"
+    doc = (
+        "worker/parallel/ps code must lower steps through "
+        "profiling.tracked_jit, not direct jax.jit/pjit (untracked "
+        "recompiles are invisible to the profiling plane)."
+    )
+
+    def check(self, project):
+        resolver = project.resolver
+        prefixes = tuple(s.replace("/", os.sep) for s in _SCOPE)
+        for sf in project.iter_files():
+            if not sf.rel.startswith(prefixes):
+                continue
+            minfo = resolver.module(sf.rel)
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = minfo.dotted(node.func)
+                if not _is_direct_jit(dotted):
+                    continue
+                yield Finding(
+                    self.name, sf.rel, node.lineno,
+                    f"direct {dotted}() bypasses the compile tracker — "
+                    f"use observability.profiling.tracked_jit(fn, "
+                    f"name=...) so this step's lowerings are counted "
+                    f"and cause-attributed",
+                    key=f"direct-jit:{dotted}",
+                )

@@ -35,7 +35,11 @@ _SCOPE = (
     "elasticdl_tpu/layers/",
 )
 
-_ENTRY_TAILS = {"jit", "pjit", "shard_map"}
+# tracked_jit (observability/profiling.py) is the sanctioned jit
+# entrypoint in trainer paths (compile-tracker rule) — the function it
+# wraps is traced exactly like a direct jit's and gets the same purity
+# analysis.
+_ENTRY_TAILS = {"jit", "pjit", "shard_map", "tracked_jit"}
 _WRAPPER_TAILS = {
     "grad", "value_and_grad", "vmap", "partial", "checkpoint", "remat",
     "named_call", "custom_vjp", "custom_jvp",
@@ -59,7 +63,9 @@ def _is_jit_entry(dotted):
     tail = dotted.rsplit(".", 1)[-1]
     if tail not in _ENTRY_TAILS:
         return False
-    return "jax" in dotted or dotted == tail
+    return (
+        "jax" in dotted or "profiling" in dotted or dotted == tail
+    )
 
 
 class _ParentMap:
