@@ -36,11 +36,15 @@ bench-gate:
 
 # The unified static-analysis plane (tools/edl_lint, no jax import,
 # seconds not minutes): concurrency (lock guards + ordering cycles),
-# jit-purity, env-knob registry, proto drift, rpc deadlines, metric
-# names, dead code. docs/STATIC_ANALYSIS.md has the rule catalog and
-# the suppression/baseline workflow. `lint-changed` restricts REPORTING
-# to git-changed files for fast pre-commit runs (analysis always sees
-# the whole program).
+# blocking-under-lock, jit-purity, compile-tracker, donation,
+# hot-path-sync, mesh-spec-consistency, env-knob registry, proto
+# drift, rpc deadlines, metric names, dead code — the last four ride
+# the interprocedural dataflow engine (tools/edl_lint/dataflow.py).
+# docs/STATIC_ANALYSIS.md has the rule catalog and the
+# suppression/baseline workflow; a stale baseline entry fails the run.
+# `lint-changed` restricts REPORTING to git-changed files for fast
+# pre-commit runs (analysis always sees the whole program) and reuses
+# the digest-keyed analysis cache when the tree is unchanged (<1 s).
 lint:
 	python -m tools.edl_lint
 
@@ -66,10 +70,12 @@ native:
 # the single trailing CI: line is the machine-readable verdict.
 ci:
 	@lint=FAIL; tier1=FAIL; gate=FAIL; \
-	$(MAKE) --no-print-directory lint && lint=ok; \
+	set -o pipefail; lintlog=$$(mktemp); \
+	$(MAKE) --no-print-directory lint 2>&1 | tee $$lintlog && lint=ok; \
 	$(MAKE) --no-print-directory verify-tests && tier1=ok; \
 	$(MAKE) --no-print-directory bench-gate && gate=ok; \
-	echo "CI: lint=$$lint tier1=$$tier1 bench-gate=$$gate"; \
+	rules=$$(grep -ao 'per-rule: .*' $$lintlog | tail -1); rm -f $$lintlog; \
+	echo "CI: lint=$$lint tier1=$$tier1 bench-gate=$$gate$${rules:+ [$$rules]}"; \
 	[ "$$lint" = ok ] && [ "$$tier1" = ok ] && [ "$$gate" = ok ]
 
 .PHONY: proto test verify verify-tests bench-smoke bench-gate lint lint-changed chaos obs native ci

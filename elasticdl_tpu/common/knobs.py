@@ -245,6 +245,12 @@ declare("ELASTICDL_PS_DEGRADED_BLOCK_SECONDS", "float", 20.0,
 declare("ELASTICDL_MASTER_PATIENCE_SECONDS", "float", 120.0,
         "How long the worker task loop rides out an unreachable master "
         "before letting the failure propagate.")
+declare("ELASTICDL_JOIN_GATE_SECONDS", "float", 0.0,
+        "Join-gate wait budget at an elastic regroup; 0 (default) "
+        "auto-derives max(90 s, 20 x the longest step compile the "
+        "compile tracker has observed), capped at 600 s, so loaded "
+        "boxes whose ~6.5 s compiles outlast a fixed gate scale the "
+        "wait instead of churning membership.")
 
 # -- bench subsystem (elasticdl_tpu/bench/) --
 declare("ELASTICDL_BENCH_WATCHDOG_S", "float", 600.0,

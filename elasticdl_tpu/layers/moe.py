@@ -14,6 +14,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from elasticdl_tpu.parallel.mesh import MODEL_AXIS
+
 
 class SwitchMoE(nn.Module):
     """Top-1 routed expert FFN. Returns (output [B, S, D], aux_loss) —
@@ -98,11 +100,16 @@ class SwitchMoE(nn.Module):
         return combined.reshape(b, s, d).astype(x.dtype), aux_loss
 
 
-def moe_param_specs(params, expert_axis="expert"):
+def moe_param_specs(params, expert_axis=MODEL_AXIS):
     """PartitionSpecs for a SwitchMoE param subtree, built by walking the
     actual tree so structure changes can't silently diverge: expert
     weight tensors (leading dim E) shard over `expert_axis`, everything
-    else (the router) replicates."""
+    else (the router) replicates.
+
+    The default is the trainer meshes' model axis: no production mesh
+    declares a dedicated "expert" axis, so the old "expert" default
+    produced specs that could never match the mesh they flowed into
+    (the drift class the mesh-spec-consistency lint rule rejects)."""
     from elasticdl_tpu.common.pytree_utils import nest_at, walk_dict
 
     specs = {}

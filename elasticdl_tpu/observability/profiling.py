@@ -203,6 +203,7 @@ class CompileTracker:
         self._events_cap = 256
         self.total_compiles = 0
         self.total_seconds = 0.0
+        self.peak_seconds = 0.0  # longest single compile observed
         self.by_cause = {}
 
     def classify_locked(self, name, sig, mesh_token):
@@ -232,6 +233,7 @@ class CompileTracker:
                 hist.sigs.add((mesh_token, sig))
             self.total_compiles += 1
             self.total_seconds += seconds
+            self.peak_seconds = max(self.peak_seconds, seconds)
             self.by_cause[cause] = self.by_cause.get(cause, 0) + 1
             self._events.append(
                 {
@@ -276,6 +278,15 @@ _tracker = CompileTracker()
 
 def tracker():
     return _tracker
+
+
+def peak_compile_seconds():
+    """The longest single compile this process has observed (0.0 before
+    any). Timeouts that must outlast a peer's recompile — the elastic
+    join gate above all — derive their floor from this instead of
+    guessing a constant."""
+    with _tracker._lock:
+        return _tracker.peak_seconds
 
 
 class TrackedFunction:

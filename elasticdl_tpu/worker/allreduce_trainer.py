@@ -51,6 +51,7 @@ import grpc
 import jax
 import numpy as np
 
+from elasticdl_tpu.common import knobs
 from elasticdl_tpu.common.jax_compat import shard_map
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.parallel import broadcast, distributed
@@ -83,6 +84,28 @@ DEFAULT_MAX_COMM_RETRIES = 5
 # the reference similarly retried only Horovod comm errors
 # (allreduce_trainer.py:125-139).
 RETRYABLE_ERRORS = (grpc.RpcError, RuntimeError)
+
+
+def join_gate_budget():
+    """The join-gate wait budget for an elastic regroup.
+
+    Explicit ELASTICDL_JOIN_GATE_SECONDS wins; unset/0 derives from a
+    measured-compile-time floor: a peer that must re-lower its step
+    (~6.5 s per compile on a loaded 1-core box, per the compile
+    tracker) can burn many multiples of that before reaching the gate,
+    which is exactly how the old fixed 90 s gate lost to load and
+    churned membership (epoch 14+ in the 1f1b flake)."""
+    budget = knobs.get_float("ELASTICDL_JOIN_GATE_SECONDS")
+    if budget > 0:
+        return budget
+    from elasticdl_tpu.observability import profiling
+
+    # Capped: the gate's timeout fall-through exists for masters that
+    # never answer world_ready (predating the gate) — one long flagship
+    # compile must widen the wait to minutes, not hours.
+    return min(
+        max(90.0, 20.0 * profiling.peak_compile_seconds()), 600.0
+    )
 
 
 class AllReduceTrainer(JaxTrainer):
@@ -304,25 +327,40 @@ class AllReduceTrainer(JaxTrainer):
             self._eval_host_cache = None
 
     def _state_provider(self):
-        with self._state_lock:
-            if self._variables is None:
-                return None
-            try:
-                return (
-                    jax.device_get(self._variables),
-                    jax.device_get(self._opt_state),
-                    self._version,
-                )
-            except Exception:
-                # Device arrays poisoned by an async collective failure:
-                # treat local state as lost. Regroup then falls back to a
-                # rank-0 pull (or data re-seed), instead of crashing the
-                # recovery path itself.
-                logger.warning(
-                    "Local state unreadable (poisoned by a failed step); "
-                    "discarding for recovery", exc_info=True,
-                )
-                return None
+        # Bounded retry: with buffer donation on the step path there is a
+        # microsecond-scale window each step — execution enqueue (which
+        # consumes the donated inputs) to the under-lock swap — where the
+        # attributes still name deleted arrays. A read landing there
+        # succeeds on the next attempt, once the swap publishes the new
+        # arrays. Only genuinely poisoned state (async collective
+        # failure) exhausts the retries.
+        for attempt in range(3):
+            with self._state_lock:
+                if self._variables is None:
+                    return None
+                try:
+                    return (
+                        jax.device_get(self._variables),
+                        jax.device_get(self._opt_state),
+                        self._version,
+                    )
+                except Exception:
+                    if attempt == 2:
+                        # Device arrays poisoned by an async collective
+                        # failure: treat local state as lost. Regroup
+                        # then falls back to a rank-0 pull (or data
+                        # re-seed), instead of crashing the recovery
+                        # path itself.
+                        logger.warning(
+                            "Local state unreadable (poisoned by a "
+                            "failed step); discarding for recovery",
+                            exc_info=True,
+                        )
+                        return None
+            # Lock RELEASED between attempts: the training thread needs
+            # it to complete the swap this read is waiting out.
+            time.sleep(0.05 * (attempt + 1))
+        return None
 
     # ---------- world management ----------
 
@@ -418,13 +456,20 @@ class AllReduceTrainer(JaxTrainer):
                 self._opt_state = None
         self._group_id = resp.rendezvous_id
 
-    def _await_join_gate(self, resp, timeout=90.0, poll_seconds=0.25):
+    def _await_join_gate(self, resp, timeout=None, poll_seconds=0.25):
         """Poll the master's join gate until the whole world of
         resp.rendezvous_id has arrived (world_ready), following any epoch
         bump to the newest world. Falls through with a warning after
-        `timeout` (e.g. a master predating the gate always answers
+        the budget (e.g. a master predating the gate always answers
         world_ready=False) — the jax.distributed initialization timeout
-        then remains the backstop, as before the gate existed."""
+        then remains the backstop, as before the gate existed.
+
+        timeout=None reads join_gate_budget(): the registered knob, or
+        a floor scaled to the longest compile this process has measured
+        (the fixed 90 s default lost to ~6.5 s step compiles on loaded
+        1-core boxes)."""
+        if timeout is None:
+            timeout = join_gate_budget()
         deadline = time.time() + timeout
         last_liveness = 0.0
         while time.time() < deadline:
@@ -946,10 +991,22 @@ class AllReduceTrainer(JaxTrainer):
                         slice_to,
                     )
 
-            # No buffer donation here (unlike the local trainer): a comm
-            # failure mid-step must leave (variables, opt_state) intact for
-            # the retry/re-mesh path — donated buffers would already be
-            # invalidated when the except branch snapshots state.
+            # Donate (variables, opt_state) in single-process worlds:
+            # the outputs alias the inputs, so XLA updates the
+            # params+moments in place instead of re-allocating both
+            # trees every step. After a failed step the donated inputs
+            # are gone — which the recovery path already treats as the
+            # poisoned-state case (_state_provider answers None; regroup
+            # falls back to a rank-0 pull or a data re-seed), and the
+            # per-step enqueue->swap window where the attrs briefly name
+            # deleted arrays is covered by _state_provider's bounded
+            # retry (the swap publishes the new arrays microseconds
+            # later).
+            # Multi-PROCESS worlds must NOT donate: a failed collective
+            # kills every rank's state at once, and the zero-template
+            # fallback in _sync_state_over_world would then broadcast
+            # rank 0's zeros as the recovered model — donation would
+            # turn a recoverable fault into silent corruption there.
             # Under TP, optimizer-state shardings are deliberately
             # unconstrained (None): GSPMD propagation reshards mu/nu to
             # mirror the param layout after the first step (one extra
@@ -965,6 +1022,14 @@ class AllReduceTrainer(JaxTrainer):
                 if self._tp_active() or self._pp_active()
                 else self._opt_placement(self._opt_state)
             )
+            donate = ()
+            if jax.process_count() == 1:
+                # opt_state donation additionally requires a PINNED
+                # in/out layout: when GSPMD owns it (opt_sh None, the
+                # TP/pipeline paths) the propagated output layout can't
+                # alias the replicated input buffer (XLA rejects the
+                # size mismatch), so only the variables donate there.
+                donate = (0,) if opt_sh is None else (0, 1)
             from elasticdl_tpu.observability.profiling import tracked_jit
 
             step = tracked_jit(
@@ -973,6 +1038,7 @@ class AllReduceTrainer(JaxTrainer):
                 key_argnums=(3, 4),
                 in_shardings=(var_sh, opt_sh, repl, data, data),
                 out_shardings=(var_sh, opt_sh, repl),
+                donate_argnums=donate,
             )
             self._sharded_steps[key] = step
         return step
@@ -1199,6 +1265,7 @@ class AllReduceTrainer(JaxTrainer):
                     # a host round trip — so comm errors land inside this
                     # try block and the re-mesh/retry path below runs,
                     # instead of exploding later at a logging float().
+                    # edl-lint: disable=hot-path-sync
                     jax.block_until_ready(loss)
                 return True, self._version, loss
             except RETRYABLE_ERRORS:
@@ -1241,6 +1308,13 @@ class AllReduceTrainer(JaxTrainer):
         # part of the rank-0 broadcast state, so fold_in(base, version) is
         # history-independent and agrees everywhere.
         step_rng = jax.random.fold_in(self._step_rng_base, self._version)
+        # The step call stays OUTSIDE the state lock: a fresh
+        # (real_n, padded_n) key compiles here (seconds), and holding
+        # the lock across it would stall the broadcast provider past a
+        # regrouping peer's pull budget. Donation is still safe: the
+        # donated inputs are consumed at execution ENQUEUE — after
+        # compile, microseconds before the under-lock swap below — and
+        # _state_provider retries across exactly that window.
         with self._mesh:
             new_variables, new_opt_state, loss = step(
                 self._variables,

@@ -273,8 +273,14 @@ class ParameterServerTrainer(JaxTrainer):
             # key_argnums=(): params/opt_state/grads shapes are static
             # after init, and hashing three full trees per step is the
             # cost the train-step key deliberately avoids.
+            # donate (params, opt_state): the caller replaces both with
+            # the results, so XLA updates in place instead of
+            # re-allocating a params+moments copy every local step.
+            # grads are NOT donated — the pipelined path hands them to
+            # the push thread after this apply.
             self._local_step = tracked_jit(
-                apply, name="ps_local_apply", key_argnums=()
+                apply, name="ps_local_apply", key_argnums=(),
+                donate_argnums=(0, 1),
             )
         self._variables["params"], self._opt_state = self._local_step(
             self._variables["params"], self._opt_state, param_grads
@@ -411,7 +417,18 @@ class ParameterServerTrainer(JaxTrainer):
         # counts are the shape axis that actually varies in PS mode.
         from elasticdl_tpu.observability.profiling import tracked_jit
 
-        return tracked_jit(step, name="ps_step", key_argnums=(2, 4, 5))
+        # Donate the mutable-state collections (new_state aliases state)
+        # and the prefetched embedding rows (the row cotangents have the
+        # rows' exact shape and dtype — the bf16 wire keeps both legs
+        # bf16 — so XLA writes the grads into the rows' buffers instead
+        # of allocating a second copy of the step's largest input).
+        # params/features/labels stay un-donated: params live on in
+        # self._variables between pulls, and the sync-mode retry loop
+        # re-feeds the same device batch after a stale rejection.
+        return tracked_jit(
+            step, name="ps_step", key_argnums=(2, 4, 5),
+            donate_argnums=(1, 2),
+        )
 
     def _build_ps_forward(self):
         from elasticdl_tpu.observability.profiling import tracked_jit
@@ -439,7 +456,13 @@ class ParameterServerTrainer(JaxTrainer):
         (sync mode) or on the push thread (pipelined async mode), where
         the device_get doubles as the wait for the step's compute."""
         with self.timing.record("push_gradients"):
-            dense_named, _ = flatten_params(jax.device_get(param_grads))
+            # ONE batched D2H for the whole gradient tree: the per-leaf
+            # np.asarray below used to issue a separate blocking
+            # transfer per embedding table (hot-path-sync).
+            param_grads, emb_grads = jax.device_get(
+                (param_grads, emb_grads)
+            )
+            dense_named, _ = flatten_params(param_grads)
             sparse = {}
             for path, g in _walk_dict(emb_grads):
                 table = path[-1]
@@ -516,12 +539,15 @@ class ParameterServerTrainer(JaxTrainer):
                 # local Adam moments once per retry would bias them.
                 if self._model_steps > 1:
                     self._apply_local(param_grads)
-                return True, self._version, float(loss)
+                # Lazy loss (Trainer contract): float() here would block
+                # the host on the device every step; callers materialize
+                # at the logging boundary.
+                return True, self._version, loss
             logger.info(
                 "Gradient push rejected as stale (attempt %d); re-pulling",
                 attempt + 1,
             )
-        return False, self._version, float(loss)
+        return False, self._version, loss
 
     def _train_minibatch_pipelined(self, features, labels):
         """Async-SGD step with the push off the critical path: while the

@@ -613,6 +613,7 @@ def test_repo_lints_clean_without_importing_jax():
     )
     env = dict(os.environ)
     env.pop("ELASTICDL_CHAOS", None)
+    load_before = os.getloadavg()[0]
     proc = subprocess.run(
         [sys.executable, "-c", check],
         cwd=REPO,
@@ -624,8 +625,17 @@ def test_repo_lints_clean_without_importing_jax():
     assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
     payload = json.loads(proc.stdout)
     assert payload["findings"] == []
+    assert payload["stale_baseline"] == []
     assert set(payload["rules"]) == {cls.name for cls in ALL_RULES}
-    assert payload["seconds"] < 30
+    # The lint lane's timing budget: the WHOLE 12-rule pass, dataflow
+    # engine included, in under 10 s (it runs before every test lane).
+    # Only enforced when the box isn't already saturated — a loaded
+    # 1-core host stretches wall time severalfold with no regression
+    # (the flake class the ROADMAP says not to chase).
+    if load_before < 4.0:
+        assert payload["seconds"] < 10, payload["seconds"]
+    # Per-rule timings ride the payload (surfaced by `make ci`).
+    assert set(payload["rule_seconds"]) == set(payload["rules"])
 
 
 def test_cli_list_rules_covers_all_families(capsys):
@@ -727,3 +737,497 @@ def test_jit_purity_covers_tracked_jit(tmp_path):
     assert "_step:time:time.time" in keys(
         run_rule(project, "jit-purity")
     )
+
+
+# ---------------------------------------------------------------------------
+# donation (dataflow engine: jit-binding index + call-site flow)
+# ---------------------------------------------------------------------------
+
+_DONATION_TRAINER = """
+    from elasticdl_tpu.observability.profiling import tracked_jit
+
+    class T:
+        def _build_step(self):
+            def step(variables, opt_state, batch):
+                return variables, opt_state, 0.0
+
+            return tracked_jit(step, name="step", key_argnums=(2,)%s)
+
+        def setup(self):
+            self._step = self._build_step()
+
+        def train(self, batch):
+            self._variables, self._opt_state, loss = self._step(
+                self._variables, self._opt_state, batch
+            )
+            return loss
+"""
+
+
+def test_donation_flags_state_consuming_step_without_donate(tmp_path):
+    project = make_project(
+        tmp_path,
+        {"elasticdl_tpu/worker/t.py": _DONATION_TRAINER % ""},
+    )
+    assert "missing-donation:step" in keys(run_rule(project, "donation"))
+
+
+def test_donation_negative_when_donated_or_not_replaced(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            # Donated: clean.
+            "elasticdl_tpu/worker/t.py": _DONATION_TRAINER
+            % ", donate_argnums=(0, 1)",
+            # Forward pattern: state flows in but is NOT replaced, so no
+            # donation is demanded (the buffers must stay alive).
+            "elasticdl_tpu/worker/fwd.py": """
+            from elasticdl_tpu.observability.profiling import tracked_jit
+
+            class F:
+                def _build(self):
+                    def forward(variables, batch):
+                        return batch
+
+                    return tracked_jit(forward, name="forward")
+
+                def setup(self):
+                    self._fwd = self._build()
+
+                def evaluate(self, batch):
+                    out = self._fwd(self._variables, batch)
+                    return out
+            """,
+        },
+    )
+    assert run_rule(project, "donation") == []
+
+
+def test_donation_use_after_donate(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "elasticdl_tpu/worker/u.py": """
+            from elasticdl_tpu.observability.profiling import tracked_jit
+
+            class U:
+                def _build(self):
+                    def apply(params, grads):
+                        return params
+
+                    return tracked_jit(
+                        apply, name="apply", donate_argnums=(0,)
+                    )
+
+                def setup(self):
+                    self._apply = self._build()
+
+                def train(self, grads):
+                    params = self.make()
+                    new_params = self._apply(params, grads)
+                    self._params = new_params
+                    return params
+            """
+        },
+    )
+    assert "use-after-donate:apply:params" in keys(
+        run_rule(project, "donation")
+    )
+
+
+def test_donation_suppression_and_baseline_round_trip(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "elasticdl_tpu/worker/t.py": (_DONATION_TRAINER % "").replace(
+                "            return tracked_jit(",
+                "            # edl-lint: disable=donation\n"
+                "            return tracked_jit(",
+            )
+        },
+    )
+    assert run_rule(project, "donation") == []
+    # Baseline keys are line-free and survive reload.
+    finding = core.Finding(
+        "donation", "elasticdl_tpu/worker/t.py", 9, "msg",
+        key="missing-donation:step",
+    )
+    path = tmp_path / "b.txt"
+    core.write_baseline(str(path), [finding])
+    assert finding.baseline_key in core.load_baseline(str(path))
+
+
+# ---------------------------------------------------------------------------
+# hot-path-sync (dataflow engine: interprocedural device-value taint)
+# ---------------------------------------------------------------------------
+
+_SYNC_TRAINER = """
+    import jax
+    import numpy as np
+
+    from elasticdl_tpu.observability.profiling import tracked_jit
+
+    class Trainer:
+        def _build(self):
+            def step(params, batch):
+                return params, 0.0
+
+            return tracked_jit(step, name="step")
+
+        def setup(self):
+            self._step = self._build()
+
+        def _log(self, loss):
+            return float(loss)
+
+        def train_minibatch(self, features, labels):
+            self._params, loss = self._step(self._params, features)
+            v = np.asarray(loss)
+            self._log(loss)
+            return v
+"""
+
+
+def test_hot_path_sync_flags_syncs_interprocedurally(tmp_path):
+    project = make_project(
+        tmp_path, {"elasticdl_tpu/worker/s.py": _SYNC_TRAINER}
+    )
+    got = keys(run_rule(project, "hot-path-sync"))
+    assert "sync:Trainer.train_minibatch:numpy:loss" in got
+    # float() sits in a HELPER the step loop calls — only reachable
+    # through the call graph.
+    assert "sync:Trainer._log:cast:loss" in got
+
+
+def test_hot_path_sync_device_get_sanitizes(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "elasticdl_tpu/worker/clean.py": """
+            import jax
+            import numpy as np
+
+            from elasticdl_tpu.observability.profiling import tracked_jit
+
+            class Trainer:
+                def _build(self):
+                    def step(params, batch):
+                        return params, 0.0
+
+                    return tracked_jit(step, name="step")
+
+                def setup(self):
+                    self._step = self._build()
+
+                def train_minibatch(self, features, labels):
+                    self._params, loss = self._step(
+                        self._params, features
+                    )
+                    host = jax.device_get(loss)
+                    # host values are fair game: the transfer already
+                    # happened, batched, at a deliberate boundary.
+                    np.asarray(features)
+                    return float(host)
+            """
+        },
+    )
+    assert run_rule(project, "hot-path-sync") == []
+
+
+def test_hot_path_sync_suppression(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "elasticdl_tpu/worker/s.py": _SYNC_TRAINER.replace(
+                "            v = np.asarray(loss)",
+                "            # edl-lint: disable=hot-path-sync\n"
+                "            v = np.asarray(loss)",
+            ).replace(
+                "            return float(loss)",
+                "            return float(loss)"
+                "  # edl-lint: disable=hot-path-sync",
+            )
+        },
+    )
+    assert run_rule(project, "hot-path-sync") == []
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock (lock events + dataflow fixpoint)
+# ---------------------------------------------------------------------------
+
+_BLOCKING_TREE = {
+    "elasticdl_tpu/master/holder.py": """
+    import threading
+    import time
+
+    class Holder:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def poke(self):
+            with self._lock:
+                time.sleep(1.0)
+
+        def fine(self):
+            time.sleep(1.0)  # no lock held: legal backoff
+    """,
+    "elasticdl_tpu/master/transitive.py": """
+    import threading
+
+    class Client:
+        def __init__(self, stub):
+            self._stub = stub
+
+        def fetch(self):
+            return self._stub.get_thing(1)
+
+    class Cache:
+        def __init__(self, client):
+            self._lock = threading.Lock()
+            self._client = client
+
+        def refresh(self):
+            with self._lock:
+                self._client.fetch()
+    """,
+}
+
+
+def test_blocking_under_lock_direct_and_transitive(tmp_path):
+    project = make_project(tmp_path, _BLOCKING_TREE)
+    got = keys(run_rule(project, "blocking-under-lock"))
+    assert any(
+        k.startswith("block:Holder.poke:_lock") for k in got
+    ), got
+    # Cache.refresh never blocks ITSELF — the RPC lives two hops away
+    # in Client.fetch, reached through the propagated summary.
+    assert any(
+        k.startswith("block:Cache.refresh:_lock") for k in got
+    ), got
+    # The un-locked sleep produced nothing.
+    assert not any("Holder.fine" in k for k in got)
+
+
+def test_blocking_under_lock_negative_and_suppression(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "elasticdl_tpu/master/clean.py": """
+            import queue
+            import threading
+            import time
+
+            class Clean:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+
+                def snapshot_then_wait(self):
+                    with self._lock:
+                        items = list(self._pending)
+                    # Blocking AFTER the lock released: the pattern the
+                    # fix hint prescribes.
+                    time.sleep(0.1)
+                    return self._q.get(), items
+            """,
+            "elasticdl_tpu/master/sup.py": """
+            import threading
+            import time
+
+            class Sup:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def poke(self):
+                    with self._lock:
+                        # edl-lint: disable=blocking-under-lock
+                        time.sleep(0.01)
+            """,
+        },
+    )
+    assert run_rule(project, "blocking-under-lock") == []
+
+
+# ---------------------------------------------------------------------------
+# mesh-spec-consistency
+# ---------------------------------------------------------------------------
+
+_MESH_TREE_OK = {
+    "elasticdl_tpu/parallel/build.py": """
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    def build(devices):
+        return Mesh(devices, axis_names=("data", "model"))
+
+    def spec(axis="data"):
+        return P(axis, None)
+    """,
+}
+
+
+def test_mesh_spec_clean_tree(tmp_path):
+    project = make_project(tmp_path, dict(_MESH_TREE_OK))
+    assert run_rule(project, "mesh-spec-consistency") == []
+
+
+def test_mesh_spec_flags_unknown_axis(tmp_path):
+    files = dict(_MESH_TREE_OK)
+    files["elasticdl_tpu/parallel/typo.py"] = """
+    from jax.sharding import PartitionSpec as P
+
+    def spec():
+        return P("data", "modle")
+    """
+    project = make_project(tmp_path, files)
+    assert "unknown-axis:modle" in keys(
+        run_rule(project, "mesh-spec-consistency")
+    )
+
+
+def test_mesh_spec_flags_class_level_drift(tmp_path):
+    files = dict(_MESH_TREE_OK)
+    files["elasticdl_tpu/worker/owner.py"] = """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from elasticdl_tpu.parallel.mesh import make_mesh
+
+    class Owner:
+        def make(self):
+            self._mesh = make_mesh({"data": 8})
+
+        def shard(self):
+            # "model" is declared SOMEWHERE (build.py) but not by any
+            # mesh this class can construct: the spec can never match
+            # the mesh it flows into.
+            return NamedSharding(self._mesh, P("model"))
+    """
+    project = make_project(tmp_path, files)
+    assert "axis-drift:Owner:model" in keys(
+        run_rule(project, "mesh-spec-consistency")
+    )
+
+
+def test_mesh_spec_incremental_dict_and_suppression(tmp_path):
+    files = dict(_MESH_TREE_OK)
+    # Incremental axis dict (the _make_world_mesh idiom) declares the
+    # axis; and a suppressed typo stays quiet.
+    files["elasticdl_tpu/worker/incr.py"] = """
+    from jax.sharding import PartitionSpec as P
+
+    from elasticdl_tpu.parallel.mesh import make_mesh
+
+    def build(tp):
+        axes = {"data": -1}
+        if tp > 1:
+            axes["seq"] = tp
+        return make_mesh(axes)
+
+    def spec():
+        return P("seq")
+
+    def odd():
+        # edl-lint: disable=mesh-spec-consistency
+        return P("weird")
+    """
+    project = make_project(tmp_path, files)
+    assert run_rule(project, "mesh-spec-consistency") == []
+
+
+# ---------------------------------------------------------------------------
+# real-defect pins: the speed-arc fixes stay fixed
+# ---------------------------------------------------------------------------
+
+
+def test_real_tree_clean_under_the_dataflow_rules():
+    """Each fixed defect re-fires its rule if regressed: donation on
+    ps_step/ps_local_apply/allreduce_step, the sync-mode float(loss),
+    the per-table D2H in _push_payload, and the MoE 'expert' axis
+    drift."""
+    project = Project.load(REPO)
+    for rule in (
+        "donation",
+        "hot-path-sync",
+        "blocking-under-lock",
+        "mesh-spec-consistency",
+    ):
+        assert run_rule(project, rule) == [], rule
+
+
+def test_real_defect_pins_source_level():
+    """Belt-and-braces pins on the exact fixes (the rules above are the
+    behavioral pin; these catch a rule being weakened instead)."""
+    ps = open(
+        os.path.join(REPO, "elasticdl_tpu/worker/ps_trainer.py")
+    ).read()
+    assert "donate_argnums=(1, 2)" in ps  # ps_step: state + emb_rows
+    assert "donate_argnums=(0, 1)" in ps  # ps_local_apply
+    assert "float(loss)" not in ps  # sync path returns the lazy loss
+    ar = open(
+        os.path.join(REPO, "elasticdl_tpu/worker/allreduce_trainer.py")
+    ).read()
+    assert "donate_argnums=donate" in ar
+    moe = open(os.path.join(REPO, "elasticdl_tpu/layers/moe.py")).read()
+    assert 'expert_axis="expert"' not in moe
+
+
+# ---------------------------------------------------------------------------
+# CLI satellites: stale baseline, json schema, analysis cache
+# ---------------------------------------------------------------------------
+
+
+def test_stale_baseline_fails_and_write_baseline_prunes(
+    tmp_path, monkeypatch, capsys
+):
+    from tools.edl_lint import cli
+
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text("dead-code|nowhere.py|dead:ghost\n")
+    monkeypatch.setattr(cli, "BASELINE_PATH", str(baseline))
+    rc = cli.run(["--changed", "--format=json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1  # clean tree, but the ghost entry is stale debt
+    assert payload["stale_baseline"] == [
+        "dead-code|nowhere.py|dead:ghost"
+    ]
+    assert cli.run(["--write-baseline"]) == 0
+    assert "ghost" not in baseline.read_text()
+
+
+def test_finding_json_schema_carries_fix_hint():
+    f = core.Finding(
+        "donation", "a.py", 3, "msg", key="k", fix_hint="do the thing"
+    )
+    d = f.as_dict()
+    assert set(d) == {
+        "rule", "path", "line", "message", "key", "fix_hint"
+    }
+    assert d["fix_hint"] == "do the thing"
+    # Default hint is the empty string, never absent.
+    assert core.Finding("r", "p", 1, "m").as_dict()["fix_hint"] == ""
+
+
+def test_lint_changed_reuses_cached_analysis():
+    """`make lint-changed` budget: with an unchanged tree the analysis
+    products are reloaded from the digest-keyed cache instead of being
+    recomputed, keeping the changed-files path under 3 s."""
+    env = dict(os.environ)
+    env.pop("ELASTICDL_CHAOS", None)
+    first = subprocess.run(
+        [sys.executable, "-m", "tools.edl_lint", "--format=json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert first.returncode == 0, first.stdout[-2000:]
+    load_before = os.getloadavg()[0]
+    second = subprocess.run(
+        [sys.executable, "-m", "tools.edl_lint", "--changed",
+         "--format=json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert second.returncode == 0, second.stdout[-2000:]
+    payload = json.loads(second.stdout)
+    assert payload["cache"] is True
+    # Budget enforced only off a saturated box (see the timing note in
+    # test_repo_lints_clean_without_importing_jax).
+    if load_before < 4.0:
+        assert payload["seconds"] < 3, payload["seconds"]

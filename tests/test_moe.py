@@ -52,8 +52,9 @@ def test_gradients_flow_to_experts_and_router():
 
 
 def test_expert_parallel_matches_replicated():
-    """Experts sharded over an 8-way 'expert' axis: loss and gradients
-    match the unsharded run."""
+    """Experts sharded 8-way over the canonical model axis (the
+    moe_param_specs default — no production mesh declares a dedicated
+    'expert' axis): loss and gradients match the unsharded run."""
     layer, variables, x = _make(num_experts=8, d=16, hidden=32, b=2, s=16)
     params = dict(variables)["params"]
 
@@ -63,7 +64,7 @@ def test_expert_parallel_matches_replicated():
 
     expected_loss, expected_grads = jax.value_and_grad(loss_fn)(params, x)
 
-    mesh = Mesh(np.array(jax.devices()[:8]), ("expert",))
+    mesh = Mesh(np.array(jax.devices()[:8]), ("model",))
     specs = moe_param_specs(params)
     param_sh = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s),

@@ -461,3 +461,21 @@ def test_world_change_emits_mesh_change_compile(tmp_path):
     compiles = agg.summary()["compiles"]
     assert compiles["edl_compile_seconds_total"] > 0
     assert compiles["by_cause"].get("mesh_change", 0) >= 1
+
+
+def test_join_gate_budget_derives_from_measured_compiles(monkeypatch):
+    """The elastic join gate scales with the longest compile this
+    process has actually measured (the fixed 90 s gate lost to ~6.5 s
+    step compiles on loaded 1-core boxes); the registered knob
+    overrides."""
+    from elasticdl_tpu.worker.allreduce_trainer import join_gate_budget
+
+    monkeypatch.delenv("ELASTICDL_JOIN_GATE_SECONDS", raising=False)
+    monkeypatch.setattr(profiling.tracker(), "peak_seconds", 0.0)
+    assert join_gate_budget() == 90.0  # floor before any compile
+    monkeypatch.setattr(profiling.tracker(), "peak_seconds", 6.5)
+    assert join_gate_budget() == 130.0  # 20x the measured compile
+    monkeypatch.setattr(profiling.tracker(), "peak_seconds", 300.0)
+    assert join_gate_budget() == 600.0  # capped: minutes, not hours
+    monkeypatch.setenv("ELASTICDL_JOIN_GATE_SECONDS", "42")
+    assert join_gate_budget() == 42.0  # explicit knob wins

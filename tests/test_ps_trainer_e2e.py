@@ -340,3 +340,39 @@ def test_bf16_wire_is_device_native():
             client.close()
         for s in servers:
             s.stop()
+
+
+def test_sync_push_path_lazy_loss_and_donated_steps_converge():
+    """Pins the speed-arc fixes on the inline (non-pipelined) push path:
+    train_minibatch returns the LAZY device loss (the old float() forced
+    a host sync every step — the hot-path-sync lint finding), and
+    repeated steps through the donated ps_step / ps_local_apply buffers
+    (donate_argnums — the donation lint finding) still converge."""
+    spec = get_model_spec("test_module")
+    servers, addrs = start_pservers(2, spec)
+    trainer = None
+    try:
+        records = test_module.make_linear_records(128)
+        trainer = ParameterServerTrainer(
+            spec.build_model(),
+            spec.loss,
+            spec.build_optimizer_spec(),
+            PSClient(addrs),
+            pipeline_pushes=False,  # the inline push loop
+            model_steps=2,  # exercises the donated ps_local_apply too
+        )
+        feats, labels = test_module.feed(records[:32], "training", None)
+        losses = []
+        for _ in range(30):
+            ok, _, loss = trainer.train_minibatch(feats, labels)
+            assert ok
+            losses.append(loss)
+        # Lazy device scalar, not a Python float: the host only blocks
+        # when a caller deliberately materializes.
+        assert not isinstance(losses[0], float), type(losses[0])
+        assert float(losses[-1]) < float(losses[0])
+    finally:
+        if trainer is not None:
+            trainer.close()
+        for s in servers:
+            s.stop()

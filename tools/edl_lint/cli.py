@@ -2,24 +2,31 @@
 
 Runs the selected rules over the shared Project cache, applies inline
 suppressions and the checked-in baseline, and reports. Exit 1 on any
-non-baselined finding (or a parse error), 0 otherwise.
+non-baselined finding, a parse error, or a STALE baseline entry (a key
+that no longer fires — fix the baseline so it only ever lists live,
+deliberate debt), 0 otherwise.
 
 Modes:
   (default)            lint everything
   PATH [PATH...]       report only findings under the given path prefixes
   --changed            report only findings in files `git diff` says
                        changed (analysis stays whole-program, so cross-
-                       file rules still see the full picture)
+                       file rules still see the full picture); reuses
+                       the cached analysis when the tree is unchanged
   --rules A,B          run only the named rules
   --list-rules         print the rule catalog and exit
-  --json               machine-readable findings on stdout
+  --format=json        machine-readable findings on stdout (stable
+                       schema: rule/path/line/message/key/fix_hint);
+                       --json is the legacy alias
   --write-baseline     regenerate tools/edl_lint/baseline.txt from the
-                       current findings (review the diff!)
+                       current findings (review the diff!) — also the
+                       way stale entries are pruned
   --no-baseline        ignore the baseline (see every finding)
   --write-knob-docs    regenerate docs/KNOBS.md from common/knobs.py
 """
 
 import argparse
+import hashlib
 import json
 import os
 import subprocess
@@ -37,6 +44,10 @@ from tools.edl_lint.loader import Project  # noqa: E402
 from tools.edl_lint.rules import ALL_RULES, rule_by_name  # noqa: E402
 
 BASELINE_PATH = os.path.join(REPO, "tools", "edl_lint", "baseline.txt")
+# Whole-analysis cache (findings + per-rule timings keyed by a content
+# digest of every analyzed file AND the lint plane itself). Lives under
+# .git so it never dirties the working tree; missing .git disables it.
+CACHE_PATH = os.path.join(REPO, ".git", "edl-lint-cache.json")
 
 
 def _changed_files():
@@ -63,6 +74,95 @@ def _changed_files():
     return paths
 
 
+# -- analysis cache ---------------------------------------------------------
+
+
+def _tree_digest(project):
+    """Content digest of every analyzed source plus the lint plane's own
+    sources — editing a rule invalidates the cache even though the rule
+    files are excluded from analysis."""
+    h = hashlib.sha256()
+    for rel in sorted(project.files):
+        sf = project.files[rel]
+        h.update(rel.encode())
+        h.update(hashlib.sha256(sf.source.encode()).digest())
+    lint_root = os.path.join(REPO, "tools", "edl_lint")
+    for dirpath, dirnames, filenames in os.walk(lint_root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            h.update(os.path.relpath(path, REPO).encode())
+            try:
+                with open(path, "rb") as f:
+                    h.update(hashlib.sha256(f.read()).digest())
+            except OSError:
+                pass
+    return h.hexdigest()
+
+
+def _load_cache(digest):
+    try:
+        with open(CACHE_PATH) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if payload.get("digest") != digest:
+        return None
+    return payload
+
+
+def _write_cache(digest, findings, suppressed, files_scanned,
+                 rule_seconds):
+    if not os.path.isdir(os.path.dirname(CACHE_PATH)):
+        return
+    payload = {
+        "digest": digest,
+        "findings": [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,
+                "message": f.message,
+                "key": f.key,
+                "fix_hint": f.fix_hint,
+            }
+            for f in findings
+        ],
+        "suppressed": suppressed,
+        "files_scanned": files_scanned,
+        "rule_seconds": rule_seconds,
+    }
+    tmp = CACHE_PATH + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, CACHE_PATH)
+    except OSError:
+        pass
+
+
+def _findings_from_cache(payload):
+    return [
+        core.Finding(
+            d["rule"], d["path"], d["line"], d["message"],
+            key=d["key"], fix_hint=d.get("fix_hint", ""),
+        )
+        for d in payload["findings"]
+    ]
+
+
+def _timing_note(rule_seconds):
+    parts = " ".join(
+        f"{name}={seconds:.2f}s"
+        for name, seconds in sorted(
+            rule_seconds.items(), key=lambda kv: -kv[1]
+        )
+    )
+    return f"per-rule: {parts}" if parts else ""
+
+
 def run(argv=None):
     parser = argparse.ArgumentParser(
         prog="python -m tools.edl_lint",
@@ -73,7 +173,12 @@ def run(argv=None):
     parser.add_argument("--rules", default="",
                         help="comma-separated rule subset")
     parser.add_argument("--list-rules", action="store_true")
-    parser.add_argument("--json", action="store_true", dest="as_json")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text", dest="fmt",
+                        help="output format (json: stable "
+                             "rule/path/line/message/key/fix_hint schema)")
+    parser.add_argument("--json", action="store_const", const="json",
+                        dest="fmt", help="alias for --format=json")
     parser.add_argument("--changed", action="store_true",
                         help="report only findings in git-changed files")
     parser.add_argument("--write-baseline", action="store_true")
@@ -83,7 +188,7 @@ def run(argv=None):
 
     if args.list_rules:
         for cls in ALL_RULES:
-            print(f"{cls.name:>14}  {' '.join(cls.doc.split())}")
+            print(f"{cls.name:>20}  {' '.join(cls.doc.split())}")
         return 0
 
     if args.write_knob_docs:
@@ -105,27 +210,50 @@ def run(argv=None):
                          f"(--list-rules shows the catalog)")
     else:
         selected = list(ALL_RULES)
+    all_rules = len(selected) == len(ALL_RULES)
 
     project = Project.load(REPO)
-    findings = []
-    for cls in selected:
-        findings.extend(cls().check(project))
-    for rel, lineno, message in project.parse_errors:
-        findings.append(core.Finding(
-            "parse", rel, lineno, f"syntax error: {message}",
-            key="syntax-error",
-        ))
+    digest = _tree_digest(project) if all_rules else None
+    cache = _load_cache(digest) if (all_rules and args.changed) else None
 
-    # Inline suppressions.
-    kept = []
-    suppressed = 0
-    for f in findings:
-        sf = project.files.get(f.path)
-        if sf is not None and core.is_suppressed(f, sf.suppressions):
-            suppressed += 1
-        else:
-            kept.append(f)
-    findings = kept
+    if cache is not None:
+        findings = _findings_from_cache(cache)
+        suppressed = cache["suppressed"]
+        rule_seconds = cache["rule_seconds"]
+        files_scanned = cache["files_scanned"]
+        from_cache = True
+    else:
+        from_cache = False
+        files_scanned = len(project.files)
+        findings = []
+        rule_seconds = {}
+        for cls in selected:
+            rule_started = time.monotonic()
+            findings.extend(cls().check(project))
+            rule_seconds[cls.name] = round(
+                time.monotonic() - rule_started, 3
+            )
+        for rel, lineno, message in project.parse_errors:
+            findings.append(core.Finding(
+                "parse", rel, lineno, f"syntax error: {message}",
+                key="syntax-error",
+            ))
+
+        # Inline suppressions.
+        kept = []
+        suppressed = 0
+        for f in findings:
+            sf = project.files.get(f.path)
+            if sf is not None and core.is_suppressed(f, sf.suppressions):
+                suppressed += 1
+            else:
+                kept.append(f)
+        findings = kept
+        if all_rules and digest is not None:
+            _write_cache(
+                digest, findings, suppressed, files_scanned,
+                rule_seconds,
+            )
 
     if args.write_baseline:
         keys = core.write_baseline(BASELINE_PATH, findings)
@@ -139,6 +267,15 @@ def run(argv=None):
     )
     fresh = [f for f in findings if f.baseline_key not in baseline]
     grandfathered = len(findings) - len(fresh)
+    # A baseline key that no longer fires is stale debt bookkeeping:
+    # fail so the file shrinks the moment a grandfathered finding is
+    # fixed (--write-baseline prunes). Only meaningful when every rule
+    # ran — a subset run can't tell stale from not-checked.
+    stale = (
+        sorted(baseline - {f.baseline_key for f in findings})
+        if all_rules
+        else []
+    )
 
     # Reporting filters (analysis already ran whole-program).
     scope_note = ""
@@ -155,18 +292,24 @@ def run(argv=None):
             if os.path.normpath(f.path).startswith(prefixes)
         ]
         scope_note += f" [paths: {', '.join(prefixes)}]"
+    if from_cache:
+        scope_note += " [cached analysis]"
 
     fresh.sort(key=lambda f: (f.path, f.line, f.rule))
     elapsed = time.monotonic() - started
+    failed = bool(fresh) or bool(stale)
 
-    if args.as_json:
+    if args.fmt == "json":
         print(json.dumps(
             {
                 "findings": [f.as_dict() for f in fresh],
                 "baselined": grandfathered,
+                "stale_baseline": stale,
                 "suppressed": suppressed,
-                "files_scanned": len(project.files),
+                "files_scanned": files_scanned,
                 "rules": [cls.name for cls in selected],
+                "rule_seconds": rule_seconds,
+                "cache": from_cache,
                 "seconds": round(elapsed, 3),
             },
             indent=2,
@@ -174,14 +317,23 @@ def run(argv=None):
     else:
         for f in fresh:
             print(f"{f.path}:{f.line}: [{f.rule}] {f.message}")
-        status = "FAIL" if fresh else "OK"
+        for key in stale:
+            print(
+                f"stale baseline entry: {key} (no longer fires — run "
+                f"--write-baseline to prune)"
+            )
+        status = "FAIL" if failed else "OK"
         print(
             f"edl-lint: {status} — {len(fresh)} finding(s), "
-            f"{grandfathered} baselined, {suppressed} suppressed; "
-            f"{len(project.files)} files, "
+            f"{grandfathered} baselined, {len(stale)} stale, "
+            f"{suppressed} suppressed; "
+            f"{files_scanned} files, "
             f"{len(selected)} rule(s), {elapsed:.1f}s{scope_note}"
         )
-    return 1 if fresh else 0
+        note = _timing_note(rule_seconds)
+        if note:
+            print(note)
+    return 1 if failed else 0
 
 
 def main():

@@ -14,14 +14,17 @@ class Finding:
     full baseline key is "<rule>|<path>|<key>".
     """
 
-    __slots__ = ("rule", "path", "line", "message", "key")
+    __slots__ = ("rule", "path", "line", "message", "key", "fix_hint")
 
-    def __init__(self, rule, path, line, message, key=None):
+    def __init__(self, rule, path, line, message, key=None, fix_hint=""):
         self.rule = rule
         self.path = path
         self.line = line
         self.message = message
         self.key = key if key is not None else message
+        # One-line remediation note carried into --format=json (stable
+        # schema: file/line/rule/message/key/fix_hint).
+        self.fix_hint = fix_hint
 
     @property
     def baseline_key(self):
@@ -37,6 +40,7 @@ class Finding:
             "line": self.line,
             "message": self.message,
             "key": self.baseline_key,
+            "fix_hint": self.fix_hint,
         }
 
 

@@ -1,18 +1,26 @@
 """Rule registry: every rule module registers its Rule subclass here."""
 
+from tools.edl_lint.rules.blocking_under_lock import BlockingUnderLockRule
 from tools.edl_lint.rules.compile_tracker import CompileTrackerRule
 from tools.edl_lint.rules.concurrency import ConcurrencyRule
 from tools.edl_lint.rules.dead_code import DeadCodeRule
+from tools.edl_lint.rules.donation import DonationRule
 from tools.edl_lint.rules.env_knobs import EnvKnobsRule
+from tools.edl_lint.rules.hot_path_sync import HotPathSyncRule
 from tools.edl_lint.rules.jit_purity import JitPurityRule
+from tools.edl_lint.rules.mesh_spec import MeshSpecRule
 from tools.edl_lint.rules.metric_names import MetricNamesRule
 from tools.edl_lint.rules.proto_drift import ProtoDriftRule
 from tools.edl_lint.rules.rpc_deadlines import RpcDeadlinesRule
 
 ALL_RULES = (
     ConcurrencyRule,
+    BlockingUnderLockRule,
     JitPurityRule,
     CompileTrackerRule,
+    DonationRule,
+    HotPathSyncRule,
+    MeshSpecRule,
     EnvKnobsRule,
     ProtoDriftRule,
     RpcDeadlinesRule,

@@ -1,0 +1,125 @@
+"""blocking-under-lock: no unbounded waits while holding our locks.
+
+The concurrency rule's lock graph rejects lock-ORDER cycles; this rule
+rejects the other deadlock shape the chaos drills keep finding designs
+for: holding a `self._lock`-family lock while performing an operation
+that can block indefinitely —
+
+- an RPC stub call (the retry/breaker stack can spin a call for its
+  whole deadline x attempts budget under brownout),
+- `time.sleep` (backoff loops),
+- `Future.result()` (a quorum wait that never fills),
+- a `queue.Queue.get()` (a producer that died still holding work).
+
+Any OTHER thread that needs the held lock (a gRPC servicer thread, the
+aggregator, a watchdog) then stalls behind a wait that chaos can extend
+arbitrarily — the classic grpc-threadpool-exhaustion deadlock.
+
+Reachability is interprocedural: the per-class event scan the
+concurrency rule already performs records blocking sinks and
+cross-class calls; `dataflow.propagate_facts` saturates "may block"
+over the whole call graph, so a lock held around an innocent-looking
+helper that (three calls down) sleeps in a backoff loop is still
+caught.
+
+Scope: master/, ps/, observability/, worker/, common/ — everywhere a
+lock-owning class and the RPC plane coexist.
+"""
+
+import os
+
+from tools.edl_lint.core import Finding, Rule
+from tools.edl_lint.dataflow import propagate_facts
+from tools.edl_lint.rules.concurrency import class_models
+
+_SCOPE = (
+    "elasticdl_tpu/master/",
+    "elasticdl_tpu/ps/",
+    "elasticdl_tpu/observability/",
+    "elasticdl_tpu/worker/",
+    "elasticdl_tpu/common/",
+)
+
+
+class BlockingUnderLockRule(Rule):
+    name = "blocking-under-lock"
+    doc = (
+        "No RPC stub call, time.sleep, Future.result(), or queue get() "
+        "may be reachable while a self-lock is held — chaos can extend "
+        "any of them past every other thread's patience."
+    )
+
+    def check(self, project):
+        prefixes = tuple(s.replace("/", os.sep) for s in _SCOPE)
+        # EVERY class in scope (not just lock owners): lock-free classes
+        # contribute call edges and sinks that a lock holder can reach
+        # transitively. The models themselves are the shared per-Project
+        # cache the concurrency rule also reads.
+        models = [
+            m
+            for m in class_models(project)
+            if m.rel.startswith(prefixes)
+        ]
+
+        direct = {}  # (cls, method) -> {sink descriptions}
+        callees = {}  # (cls, method) -> {(cls, method)}
+        for model in models:
+            for method, events in model.events.items():
+                key = (model.name, method)
+                direct.setdefault(key, set())
+                callees.setdefault(key, set())
+                for _, event in events:
+                    if event[0] == "sink":
+                        direct[key].add(event[1])
+                    elif event[0] == "call":
+                        callees[key].add((event[1], event[2]))
+        may_block = propagate_facts(direct, callees)
+
+        seen = set()
+        for model in models:
+            if not model.lock_attrs:
+                continue
+            for method, events in model.events.items():
+                for held, event in events:
+                    if not held:
+                        continue
+                    if event[0] == "sink":
+                        desc, line = event[1], event[2]
+                        via = ""
+                    elif event[0] == "call":
+                        facts = may_block.get(
+                            (event[1], event[2]), ()
+                        )
+                        if not facts:
+                            continue
+                        desc = sorted(facts)[0]
+                        line = event[3]
+                        via = f" via {event[1]}.{event[2]}()"
+                    else:
+                        continue
+                    locks = ", ".join(
+                        f"{model.name}.{h}" for h in sorted(held)
+                    )
+                    key = (
+                        f"block:{model.name}.{method}:"
+                        f"{'+'.join(sorted(held))}:{desc}"
+                    )
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield Finding(
+                        self.name,
+                        model.rel,
+                        line,
+                        f"{model.name}.{method} holds {locks} while "
+                        f"reaching a blocking operation{via}: {desc} — "
+                        f"any thread needing the lock stalls behind an "
+                        f"unbounded wait (deadlock under chaos)",
+                        key=key,
+                        fix_hint=(
+                            "move the blocking call outside the lock "
+                            "(snapshot state under the lock, wait "
+                            "after), or bound the wait and suppress "
+                            "with a justification"
+                        ),
+                    )

@@ -23,11 +23,21 @@ import ast
 import os
 
 from tools.edl_lint.core import Finding, Rule
+from tools.edl_lint.dataflow import self_attr_chain
 
 _LOCK_FACTORIES = {
     "threading.Lock",
     "threading.RLock",
     "threading.Condition",
+}
+
+# Factories whose .get() blocks (queue.Queue's own lock is internal and
+# thread-safe — the hazard is BLOCKING on it while holding one of ours).
+_QUEUE_FACTORIES = {
+    "queue.Queue",
+    "queue.SimpleQueue",
+    "queue.LifoQueue",
+    "queue.PriorityQueue",
 }
 
 # Mutating container methods that count as writes for guard analysis.
@@ -95,6 +105,7 @@ class _ClassModel:
         self.minfo = minfo
         self.resolver = resolver
         self.lock_attrs = set()
+        self.queue_attrs = set()
         self.field_classes = {}  # self.<field> -> class name
         self.methods = {}  # name -> FunctionDef
         for stmt in classdef.body:
@@ -104,7 +115,8 @@ class _ClassModel:
         self._find_field_classes()
         # method -> [(held frozenset, event)] where event is
         # ("acquire", lock, line) | ("write", attr, line) |
-        # ("call", class_name, method_name, line)
+        # ("call", class_name, method_name, line) |
+        # ("sink", blocking-op description, line)  [blocking-under-lock]
         self.events = {
             name: self._scan_method(fn)
             for name, fn in self.methods.items()
@@ -118,6 +130,11 @@ class _ClassModel:
                 if not isinstance(node.value, ast.Call):
                     continue
                 dotted = self.minfo.dotted(node.value.func)
+                if dotted in _QUEUE_FACTORIES:
+                    for target in node.targets:
+                        attr = _self_attr(target)
+                        if attr:
+                            self.queue_attrs.add(attr)
                 if dotted not in _LOCK_FACTORIES:
                     continue
                 for target in node.targets:
@@ -231,6 +248,7 @@ class _ClassModel:
         walk(stmt)
 
     def _scan_call(self, call, held, events):
+        self._scan_blocking(call, held, events)
         func = call.func
         if not isinstance(func, ast.Attribute):
             return
@@ -255,6 +273,59 @@ class _ClassModel:
                 (held, ("call", target_class, func.attr, call.lineno))
             )
 
+    def _scan_blocking(self, call, held, events):
+        """Blocking-operation events for the blocking-under-lock rule:
+        time.sleep, Future.result(), queue .get(), and RPC stub calls."""
+        dotted = self.minfo.dotted(call.func) or ""
+        if dotted == "time.sleep":
+            events.append((held, ("sink", "time.sleep()", call.lineno)))
+            return
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return
+        if func.attr == "result":
+            events.append(
+                (held, ("sink", ".result() (future wait)", call.lineno))
+            )
+            return
+        field = self_attr_chain(func.value)
+        if func.attr == "get" and field in self.queue_attrs:
+            events.append(
+                (held, ("sink", f"self.{field}.get() (queue wait)",
+                        call.lineno))
+            )
+            return
+        # RPC: a call through a gRPC stub — the field's inferred class is
+        # rpc.Stub, or the receiver chain names a *stub* attribute.
+        if field is not None:
+            if (
+                self.field_classes.get(field) == "Stub"
+                or "stub" in field.lower()
+            ):
+                events.append(
+                    (held, ("sink", f"RPC self.{field}.{func.attr}(...)",
+                            call.lineno))
+                )
+
+
+def class_models(project):
+    """Every library class's _ClassModel, built once per Project and
+    shared by the concurrency and blocking-under-lock rules (same
+    pattern as dataflow.get_engine — the per-class event scan is the
+    expensive part and must not diverge between the two consumers)."""
+    models = getattr(project, "_edl_class_models", None)
+    if models is None:
+        resolver = project.resolver
+        models = []
+        for sf in project.iter_files("elasticdl_tpu"):
+            minfo = resolver.module(sf.rel)
+            for classdef in minfo.classes.values():
+                models.append(
+                    _ClassModel(sf.rel, classdef, minfo, resolver)
+                )
+        project._edl_class_models = models
+    return models
+
 
 class ConcurrencyRule(Rule):
     name = "concurrency"
@@ -265,14 +336,7 @@ class ConcurrencyRule(Rule):
     )
 
     def check(self, project):
-        resolver = project.resolver
-        models = []
-        for sf in project.iter_files("elasticdl_tpu"):
-            minfo = resolver.module(sf.rel)
-            for classdef in minfo.classes.values():
-                model = _ClassModel(sf.rel, classdef, minfo, resolver)
-                if model.lock_attrs:
-                    models.append(model)
+        models = [m for m in class_models(project) if m.lock_attrs]
         yield from self._check_guards(models)
         yield from self._check_ordering(models)
 
@@ -324,6 +388,9 @@ class ConcurrencyRule(Rule):
         # computed as an iterative fixpoint over the whole call graph —
         # NOT a memoized DFS, whose cycle cutoff would cache truncated
         # sets for mutually-recursive methods and silently drop edges.
+        # (dataflow.propagate_facts is that fixpoint, generalized.)
+        from tools.edl_lint.dataflow import propagate_facts
+
         direct = {}  # (cls, method) -> {lock nodes acquired directly}
         callees = {}  # (cls, method) -> {(cls2, method2) called}
         for model in in_scope:
@@ -336,16 +403,7 @@ class ConcurrencyRule(Rule):
                         direct[key].add(f"{model.name}.{event[1]}")
                     elif event[0] == "call":
                         callees[key].add((event[1], event[2]))
-        acquires = {key: set(locks) for key, locks in direct.items()}
-        changed = True
-        while changed:
-            changed = False
-            for key, called in callees.items():
-                for callee in called:
-                    extra = acquires.get(callee, ())
-                    if not acquires[key].issuperset(extra):
-                        acquires[key] |= extra
-                        changed = True
+        acquires = propagate_facts(direct, callees)
 
         def may_acquire(cls, method):
             return acquires.get((cls, method), set())

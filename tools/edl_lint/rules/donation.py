@@ -1,0 +1,375 @@
+"""donation: buffer-donation hygiene on the jit step paths.
+
+Two halves, both riding the dataflow engine's jit-binding index:
+
+(a) **missing donation** — a `tracked_jit`/`jax.jit` construction with NO
+    `donate_argnums`/`donate_argnames`, whose call sites feed it trainer
+    state (values flowing from `self.<attr>`) AND consume-and-replace
+    that state with the call's results (tuple-assign back to the same
+    attrs, or `self.<attr>.update(<result>)`). That shape — state in,
+    new state out — is exactly where donation is free performance: XLA
+    reuses the input buffers for the outputs instead of re-allocating
+    (params + opt_state) every step. worker/trainer.py's train_step has
+    donated since PR 6; this rule makes the other step paths keep up.
+
+(b) **use-after-donate** — the inverse correctness bug: a construction
+    WITH literal donate positions whose call site passes a binding that
+    is read again after the call (including the loop-wraparound path
+    when the call sits in a loop). Donated buffers are invalidated at
+    dispatch; the late read raises (best case) or reads garbage.
+
+Scope: worker/ + parallel/ — the trainer step paths the speed arc
+rewrites.
+"""
+
+import ast
+import os
+
+from tools.edl_lint.core import Finding, Rule
+from tools.edl_lint.dataflow import get_engine, self_attr
+
+_SCOPE = ("elasticdl_tpu/worker/", "elasticdl_tpu/parallel/")
+
+
+def _stmt_parents(fn_node):
+    parents = {}
+    for node in ast.walk(fn_node):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    return parents
+
+
+def _enclosing_stmt(node, parents):
+    stmt = node
+    while id(stmt) in parents and not isinstance(stmt, ast.stmt):
+        stmt = parents[id(stmt)]
+    return stmt if isinstance(stmt, ast.stmt) else None
+
+
+def _enclosing_loop(stmt, parents):
+    node = stmt
+    while id(node) in parents:
+        node = parents[id(node)]
+        if isinstance(node, (ast.For, ast.While)):
+            return node
+    return None
+
+
+def _attr_reads(expr):
+    """self attributes whose value the expression reads (self.X loads,
+    incl. through subscripts/method chains)."""
+    attrs = set()
+    for node in ast.walk(expr):
+        attr = self_attr(node)
+        if attr:
+            attrs.add(attr)
+    return attrs
+
+
+def _local_attr_flow(fn_node):
+    """local name -> self attrs its value was derived from (one-level
+    flow through plain assignments: `state = {...self._variables...}`)."""
+    flow = {}
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            if isinstance(target, ast.Name):
+                attrs = _attr_reads(node.value)
+                if attrs:
+                    flow.setdefault(target.id, set()).update(attrs)
+    return flow
+
+
+def _tuple_bindings(fn_node):
+    """local name -> [element exprs] for `x = (a, b, c)` assignments, so
+    `f(*x)` call sites expand to positional sources."""
+    out = {}
+    for node in ast.walk(fn_node):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, (ast.Tuple, ast.List))
+        ):
+            out[node.targets[0].id] = list(node.value.elts)
+    return out
+
+
+def _positional_sources(call, tuples):
+    """position -> source expr, expanding a single `*name` splat of a
+    known local tuple."""
+    sources = {}
+    pos = 0
+    for arg in call.args:
+        if isinstance(arg, ast.Starred):
+            if (
+                isinstance(arg.value, ast.Name)
+                and arg.value.id in tuples
+            ):
+                for elt in tuples[arg.value.id]:
+                    sources[pos] = elt
+                    pos += 1
+                continue
+            return sources  # unknown splat: later positions unknowable
+        sources[pos] = arg
+        pos += 1
+    return sources
+
+
+def _result_names_and_attrs(call, parents):
+    """(bound result names, self attrs assigned from the call's result)
+    at the call's own statement."""
+    stmt = _enclosing_stmt(call, parents)
+    names, attrs = set(), set()
+    if isinstance(stmt, ast.Assign):
+        for target in stmt.targets:
+            elts = (
+                target.elts
+                if isinstance(target, (ast.Tuple, ast.List))
+                else [target]
+            )
+            for elt in elts:
+                if isinstance(elt, ast.Name):
+                    names.add(elt.id)
+                else:
+                    attrs.update(_attr_reads(elt))
+    return names, attrs
+
+
+def _attr_stores_from(fn_node, result_names):
+    """self attrs later assigned FROM a result name (replacement through
+    a local: `new_v, new_o, loss = step(...)` ... `self._variables =
+    new_v`)."""
+    attrs = set()
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (
+            isinstance(node.value, ast.Name)
+            and node.value.id in result_names
+        ):
+            continue
+        for target in node.targets:
+            attr = self_attr(target)
+            if attr:
+                attrs.add(attr)
+    return attrs
+
+
+def _updated_attrs(fn_node, result_names):
+    """self attrs replaced via `self.X.update(<result name>)`."""
+    attrs = set()
+    for node in ast.walk(fn_node):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "update"
+        ):
+            continue
+        attr = self_attr(node.func.value)
+        if not attr:
+            continue
+        if any(
+            isinstance(a, ast.Name) and a.id in result_names
+            for a in node.args
+        ):
+            attrs.add(attr)
+    return attrs
+
+
+def _literal_positions(donate_node):
+    """Literal donated argnums, or None when not statically resolvable
+    (e.g. a conditional expression)."""
+    if isinstance(donate_node, ast.Constant) and isinstance(
+        donate_node.value, int
+    ):
+        return {donate_node.value}
+    if isinstance(donate_node, (ast.Tuple, ast.List)):
+        out = set()
+        for elt in donate_node.elts:
+            if not (
+                isinstance(elt, ast.Constant)
+                and isinstance(elt.value, int)
+            ):
+                return None
+            out.add(elt.value)
+        return out
+    return None
+
+
+class DonationRule(Rule):
+    name = "donation"
+    doc = (
+        "Jit step paths that consume-and-replace trainer state must "
+        "donate its buffers (donate_argnums), and a donated binding "
+        "must never be read after the call that consumed it."
+    )
+
+    def check(self, project):
+        engine = get_engine(project)
+        prefixes = tuple(s.replace("/", os.sep) for s in _SCOPE)
+        for site in engine.jit_sites:
+            if not site.rel.startswith(prefixes):
+                continue
+            if site.donate is None:
+                yield from self._check_missing(site)
+            else:
+                yield from self._check_use_after(site)
+
+    # -- (a) missing donation --------------------------------------------
+
+    def _check_missing(self, site):
+        for caller, call in site.call_sites:
+            parents = _stmt_parents(caller.node)
+            tuples = _tuple_bindings(caller.node)
+            flow = _local_attr_flow(caller.node)
+            sources = _positional_sources(call, tuples)
+            result_names, replaced = _result_names_and_attrs(
+                call, parents
+            )
+            replaced |= _updated_attrs(caller.node, result_names)
+            replaced |= _attr_stores_from(caller.node, result_names)
+            if not replaced:
+                continue
+            consumed = []
+            for pos, expr in sorted(sources.items()):
+                attrs = _attr_reads(expr)
+                if isinstance(expr, ast.Name):
+                    attrs |= flow.get(expr.id, set())
+                if attrs & replaced:
+                    consumed.append(pos)
+            if consumed:
+                yield Finding(
+                    self.name,
+                    site.rel,
+                    site.line,
+                    f"jitted `{site.display}` consumes and replaces "
+                    f"trainer state (call at {caller.rel}:{call.lineno} "
+                    f"feeds self-state into position"
+                    f"{'s' if len(consumed) > 1 else ''} "
+                    f"{', '.join(map(str, consumed))} and assigns the "
+                    f"result back) but declares no donate_argnums — "
+                    f"every step re-allocates those buffers",
+                    key=f"missing-donation:{site.display}",
+                    fix_hint=(
+                        "pass donate_argnums covering the consumed "
+                        "state positions (or suppress with a "
+                        "justification if a failure path must keep the "
+                        "inputs alive)"
+                    ),
+                )
+                return  # one finding per construction
+
+    # -- (b) use-after-donate --------------------------------------------
+
+    def _check_use_after(self, site):
+        donated = _literal_positions(site.donate)
+        if not donated:
+            return
+        for caller, call in site.call_sites:
+            parents = _stmt_parents(caller.node)
+            tuples = _tuple_bindings(caller.node)
+            sources = _positional_sources(call, tuples)
+            stmt = _enclosing_stmt(call, parents)
+            if stmt is None:
+                continue
+            result_names, replaced_attrs = _result_names_and_attrs(
+                call, parents
+            )
+            call_span = (
+                stmt.lineno,
+                getattr(stmt, "end_lineno", stmt.lineno),
+            )
+            loop = _enclosing_loop(stmt, parents)
+            for pos in sorted(donated):
+                expr = sources.get(pos)
+                if expr is None:
+                    continue
+                binding = None
+                is_attr = False
+                if isinstance(expr, ast.Name):
+                    binding = expr.id
+                else:
+                    attr = self_attr(expr) or (
+                        self_attr(expr.value)
+                        if isinstance(expr, ast.Subscript)
+                        else None
+                    )
+                    if attr:
+                        binding = attr
+                        is_attr = True
+                if binding is None:
+                    continue
+                if is_attr and binding in replaced_attrs:
+                    continue  # reassigned by the call itself
+                read = self._late_read(
+                    caller.node, binding, is_attr, call_span, loop
+                )
+                if read is not None:
+                    yield Finding(
+                        self.name,
+                        caller.rel,
+                        read,
+                        f"`{binding}` is donated to jitted "
+                        f"`{site.display}` (position {pos}, call at "
+                        f"line {call.lineno}) but read again at line "
+                        f"{read} — donated buffers are invalidated at "
+                        f"dispatch",
+                        key=f"use-after-donate:{site.display}:{binding}",
+                        fix_hint=(
+                            "drop the late read, rebind the name "
+                            "before it, or stop donating that position"
+                        ),
+                    )
+
+    def _late_read(self, fn_node, binding, is_attr, call_span, loop):
+        """First line where `binding` is read on a path after the call:
+        statements below the call, plus the loop-wraparound path when the
+        call sits in a loop. A store to the binding kills the path."""
+        loads, stores = [], []
+        for node in ast.walk(fn_node):
+            if is_attr:
+                if isinstance(node, ast.Assign):
+                    for target in node.targets:
+                        elts = (
+                            target.elts
+                            if isinstance(target, (ast.Tuple, ast.List))
+                            else [target]
+                        )
+                        for elt in elts:
+                            if self_attr(elt) == binding or (
+                                isinstance(elt, ast.Subscript)
+                                and self_attr(elt.value) == binding
+                            ):
+                                stores.append(node.lineno)
+                attr = self_attr(node)
+                if attr == binding and isinstance(
+                    getattr(node, "ctx", None), ast.Load
+                ):
+                    loads.append(node.lineno)
+            else:
+                if isinstance(node, ast.Name) and node.id == binding:
+                    if isinstance(node.ctx, ast.Store):
+                        stores.append(node.lineno)
+                    elif isinstance(node.ctx, ast.Load):
+                        loads.append(node.lineno)
+
+        for line in sorted(loads):
+            if line > call_span[1]:
+                # Straight-line path: a store between the call and the
+                # read kills it.
+                if not any(call_span[1] < s < line for s in stores):
+                    return line
+            elif loop is not None and line >= loop.lineno:
+                # Wraparound read at the top of the next iteration. The
+                # path is call -> loop end -> loop top -> read; a store
+                # after the call OR between the loop top and the read
+                # kills it.
+                if line >= call_span[0]:
+                    continue  # the call's own argument read
+                if not (
+                    any(s > call_span[1] for s in stores)
+                    or any(loop.lineno <= s < line for s in stores)
+                ):
+                    return line
+        return None

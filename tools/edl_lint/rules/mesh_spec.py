@@ -1,0 +1,335 @@
+"""mesh-spec-consistency: PartitionSpec axes must exist on a mesh.
+
+The drift class the mesh/ZeRO unification refactor will otherwise
+create: a `PartitionSpec` names an axis ("data", "model", "seq",
+"stage", "zero") that no mesh in the program declares — GSPMD then
+fails at lowering time, deep inside a trainer rebuild, on real
+hardware, after minutes of setup. Statically the invariant is cheap:
+
+1. **Global namespace** — every statically-resolvable axis name used in
+   a `PartitionSpec(...)` (literal strings, module constants like
+   `DATA_AXIS`, parameter defaults like `axis="data"`) must be declared
+   by at least one resolvable mesh construction (`make_mesh({...})`
+   axis dicts, `Mesh(..., axis_names=(...))`) anywhere in the program.
+   A typo'd or orphaned axis name fails immediately.
+
+2. **Flow into a class's mesh** — where a class both CONSTRUCTS meshes
+   (attrs assigned from `make_mesh`/`Mesh`, directly or through builder
+   methods) and applies specs to them (`NamedSharding`, `shard_map`),
+   the resolvable axes of those specs must be a subset of the union of
+   axes its mesh constructions can produce.
+
+Axis names only resolvable at runtime (plain parameters, lambda args)
+are skipped — the rule never guesses.
+"""
+
+import ast
+import os
+
+from tools.edl_lint.core import Finding, Rule
+from tools.edl_lint.dataflow import iter_functions, self_attr
+
+_SCOPE = (
+    "elasticdl_tpu/worker/",
+    "elasticdl_tpu/parallel/",
+    "elasticdl_tpu/layers/",
+    "elasticdl_tpu/models/",
+)
+
+_SPEC_TAILS = {"PartitionSpec", "P"}
+_MESH_TAILS = {"Mesh", "make_mesh"}
+# make_mesh()'s no-argument default builds a 1-D data mesh.
+_DEFAULT_MESH_AXES = frozenset({"data"})
+
+
+def _spec_call(dotted):
+    if not dotted:
+        return False
+    tail = dotted.rsplit(".", 1)[-1]
+    return tail in _SPEC_TAILS and (
+        "sharding" in dotted or tail == dotted or tail == "P"
+    )
+
+
+class _AxisResolver:
+    """Static axis-name resolution inside one function: literals, module
+    constants (through the import graph), parameter defaults, and
+    single-assignment locals."""
+
+    def __init__(self, resolver, minfo, fn_node):
+        self.resolver = resolver
+        self.minfo = minfo
+        self.defaults = {}
+        self.locals = {}
+        self.subscript_keys = {}  # local name -> {resolvable stored keys}
+        if fn_node is not None:
+            args = fn_node.args
+            pos = args.posonlyargs + args.args
+            defaults = [None] * (len(pos) - len(args.defaults)) + list(
+                args.defaults
+            )
+            for arg, default in zip(pos, defaults):
+                if default is not None:
+                    self.defaults[arg.arg] = default
+            for kwarg, default in zip(args.kwonlyargs, args.kw_defaults):
+                if default is not None:
+                    self.defaults[kwarg.arg] = default
+            for node in ast.walk(fn_node):
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                ):
+                    name = node.targets[0].id
+                    # Multiple assignments: ambiguous, drop.
+                    if name in self.locals:
+                        self.locals[name] = None
+                    else:
+                        self.locals[name] = node.value
+                elif (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Subscript)
+                    and isinstance(node.targets[0].value, ast.Name)
+                ):
+                    # Incremental dict build: axes[MODEL_AXIS] = mp.
+                    sub = node.targets[0]
+                    self.subscript_keys.setdefault(
+                        sub.value.id, set()
+                    ).add(sub.slice)
+
+    def axis_of(self, expr, depth=0):
+        """The static axis string for an expression, or None (unknown /
+        deliberately unsharded)."""
+        if depth > 4 or expr is None:
+            return None
+        if isinstance(expr, ast.Constant):
+            return expr.value if isinstance(expr.value, str) else None
+        value = self.resolver.resolve_str(expr, self.minfo)
+        if value is not None:
+            return value
+        if isinstance(expr, ast.Name):
+            if expr.id in self.locals:
+                local = self.locals[expr.id]
+                if local is not None:
+                    return self.axis_of(local, depth + 1)
+                return None
+            if expr.id in self.defaults:
+                return self.axis_of(self.defaults[expr.id], depth + 1)
+        return None
+
+    def axes_of_spec(self, call):
+        """Resolvable axis names in one PartitionSpec(...) call."""
+        axes = set()
+        for arg in call.args:
+            if isinstance(arg, ast.Starred):
+                continue
+            if isinstance(arg, (ast.Tuple, ast.List)):
+                for elt in arg.elts:
+                    axis = self.axis_of(elt)
+                    if axis:
+                        axes.add(axis)
+                continue
+            axis = self.axis_of(arg)
+            if axis:
+                axes.add(axis)
+        return axes
+
+    def axes_of_mesh(self, call, dotted):
+        """Declared axis names of a mesh construction, or None when the
+        construction is not statically resolvable."""
+        tail = dotted.rsplit(".", 1)[-1]
+        if tail == "make_mesh":
+            if not call.args and not any(
+                kw.arg == "axis_sizes" for kw in call.keywords
+            ):
+                return set(_DEFAULT_MESH_AXES)
+            expr = call.args[0] if call.args else None
+            for kw in call.keywords:
+                if kw.arg == "axis_sizes":
+                    expr = kw.value
+            if isinstance(expr, ast.Constant) and expr.value is None:
+                return set(_DEFAULT_MESH_AXES)
+            extra_keys = ()
+            if isinstance(expr, ast.Name) and expr.id in self.locals:
+                # A dict local: its literal keys plus any incremental
+                # `axes[KEY] = n` stores in the same function.
+                extra_keys = self.subscript_keys.get(expr.id, ())
+                expr = self.locals[expr.id]
+            if isinstance(expr, ast.Dict):
+                axes = set()
+                for key in list(expr.keys) + list(extra_keys):
+                    axis = self.axis_of(key)
+                    if axis is None:
+                        return None
+                    axes.add(axis)
+                return axes
+            return None
+        # jax.sharding.Mesh(devices, axis_names=...)
+        names = None
+        if len(call.args) >= 2:
+            names = call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "axis_names":
+                names = kw.value
+        if isinstance(names, ast.Constant) and isinstance(
+            names.value, str
+        ):
+            return {names.value}
+        if isinstance(names, (ast.Tuple, ast.List)):
+            axes = set()
+            for elt in names.elts:
+                axis = self.axis_of(elt)
+                if axis is None:
+                    return None
+                axes.add(axis)
+            return axes
+        if isinstance(names, ast.Name):
+            resolved = self.axis_of(names)
+            if resolved:
+                return {resolved}
+        return None
+
+
+class MeshSpecRule(Rule):
+    name = "mesh-spec-consistency"
+    doc = (
+        "Every statically-resolvable PartitionSpec axis name must be "
+        "declared by a mesh construction; specs applied to a class's "
+        "own mesh must fit the axes that mesh can carry."
+    )
+
+    def check(self, project):
+        resolver = project.resolver
+        prefixes = tuple(s.replace("/", os.sep) for s in _SCOPE)
+
+        declared = set()  # union of all resolvable mesh axes
+        any_resolvable_mesh = False
+        spec_uses = []  # (rel, line, axes, class_name, applied_attr)
+        class_mesh_axes = {}  # (rel, class) -> set of axes
+        class_has_mesh = set()
+        mesh_builder_methods = {}  # (rel, class, method) -> axes
+
+        # Pass 1: collect mesh constructions and spec literals.
+        for sf in project.iter_files("elasticdl_tpu"):
+            minfo = resolver.module(sf.rel)
+            for qualname, class_name, fn in iter_functions(sf.tree):
+                axres = _AxisResolver(resolver, minfo, fn)
+                returns_mesh_axes = None
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    dotted = minfo.dotted(node.func) or ""
+                    tail = dotted.rsplit(".", 1)[-1]
+                    if tail in _MESH_TAILS and (
+                        "mesh" in dotted.lower() or tail == "make_mesh"
+                    ):
+                        axes = axres.axes_of_mesh(node, dotted)
+                        if axes is not None:
+                            any_resolvable_mesh = True
+                            declared |= axes
+                            if class_name:
+                                key = (sf.rel, class_name)
+                                class_mesh_axes.setdefault(
+                                    key, set()
+                                ).update(axes)
+                            if returns_mesh_axes is None:
+                                returns_mesh_axes = set()
+                            returns_mesh_axes |= axes
+                        elif class_name:
+                            # Unresolvable construction: poison the
+                            # class-level check (can't bound its axes).
+                            class_mesh_axes[(sf.rel, class_name)] = None
+                        if class_name:
+                            class_has_mesh.add((sf.rel, class_name))
+                    elif _spec_call(dotted) and sf.rel.startswith(
+                        prefixes
+                    ):
+                        axes = axres.axes_of_spec(node)
+                        if axes:
+                            spec_uses.append(
+                                (sf.rel, node.lineno, axes, class_name)
+                            )
+                if class_name and returns_mesh_axes is not None:
+                    method = qualname.rsplit(".", 1)[-1]
+                    mesh_builder_methods[
+                        (sf.rel, class_name, method)
+                    ] = returns_mesh_axes
+
+        # Builder-method flow: self._mesh = self._make_world_mesh().
+        for sf in project.iter_files("elasticdl_tpu"):
+            minfo = resolver.module(sf.rel)
+            for qualname, class_name, fn in iter_functions(sf.tree):
+                if not class_name:
+                    continue
+                for node in ast.walk(fn):
+                    if not (
+                        isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.value, ast.Call)
+                    ):
+                        continue
+                    if self_attr(node.targets[0]) is None:
+                        continue
+                    callee = self_attr(node.value.func)
+                    if callee is None:
+                        continue
+                    axes = mesh_builder_methods.get(
+                        (sf.rel, class_name, callee)
+                    )
+                    if axes is not None:
+                        key = (sf.rel, class_name)
+                        if class_mesh_axes.get(key, set()) is not None:
+                            class_mesh_axes.setdefault(
+                                key, set()
+                            ).update(axes)
+                        class_has_mesh.add(key)
+
+        if not any_resolvable_mesh:
+            return  # nothing to check against (tiny fixture trees)
+
+        # Check 1: global axis namespace.
+        for rel, line, axes, class_name in spec_uses:
+            for axis in sorted(axes - declared):
+                yield Finding(
+                    self.name,
+                    rel,
+                    line,
+                    f"PartitionSpec names axis {axis!r}, which no mesh "
+                    f"construction in the program declares (known axes: "
+                    f"{', '.join(sorted(declared))}) — GSPMD will "
+                    f"reject it at lowering time",
+                    key=f"unknown-axis:{axis}",
+                    fix_hint=(
+                        "use one of the declared mesh axis constants "
+                        "(parallel/mesh.py), or add the axis to the "
+                        "mesh that this spec shards over"
+                    ),
+                )
+
+        # Check 2: specs applied inside a mesh-owning class must fit the
+        # union of axes that class's constructions can produce.
+        for rel, line, axes, class_name in spec_uses:
+            if not class_name:
+                continue
+            key = (rel, class_name)
+            if key not in class_has_mesh:
+                continue
+            mesh_axes = class_mesh_axes.get(key)
+            if mesh_axes is None:
+                continue  # unresolvable construction present
+            for axis in sorted((axes & declared) - mesh_axes):
+                yield Finding(
+                    self.name,
+                    rel,
+                    line,
+                    f"{class_name} applies a PartitionSpec with axis "
+                    f"{axis!r} but its own mesh constructions only "
+                    f"declare {{{', '.join(sorted(mesh_axes))}}} — the "
+                    f"spec can never match the mesh it flows into",
+                    key=f"axis-drift:{class_name}:{axis}",
+                    fix_hint=(
+                        "add the axis to the class's mesh construction "
+                        "or drop it from the spec"
+                    ),
+                )
