@@ -34,7 +34,12 @@ _PHASE_BUCKETS = {
     "train_step": "compute",
     "train_step_dispatch": "compute",
     "pull_model": "ps_wire",
+    # With prefetch overlap, "prefetch_embeddings" is only the harvest
+    # wait (the pulls were issued a step ahead); "prefetch_issue" is the
+    # host-side dedup + cache lookup + RPC fire that stays on the
+    # critical path.
     "prefetch_embeddings": "input_wait",
+    "prefetch_issue": "input_wait",
 }
 _BREAKDOWN_BUCKETS = {
     "serialize": "serialize",

@@ -29,8 +29,10 @@ from elasticdl_tpu.bench import stats
 from elasticdl_tpu.observability import flightrec
 
 DEFAULT_SHARD_COUNTS = (1, 2)
-DEFAULT_CODECS = ("float32", "bfloat16")
+DEFAULT_CODECS = ("float32", "bfloat16", "int8")
 DEFAULT_PIPELINING = (False, True)
+
+_CODEC_SHORT = {"float32": "f32", "bfloat16": "bf16", "int8": "int8"}
 
 # Sub-phases PSClient records inside push_gradients (see worker/
 # ps_client.py); the matrix folds them into each cell's breakdown.
@@ -58,12 +60,17 @@ def make_batches(batch_size, n_batches=4, seed=0):
     return batches
 
 
-def run_ps_config(batches, steps, warmup, num_ps, pipelined, wire_dtype):
+def run_ps_config(batches, steps, warmup, num_ps, pipelined, wire_dtype,
+                  prefetch=True):
     """One timed run of the PS hot loop under one matrix cell's config.
 
     Returns {"examples_per_sec", "step_time_ms", "phase_mean_ms",
     "push_breakdown_ms"}. warmup should cover every distinct batch once
     (cold-row lazy init inside the timed window was the old r4 spread).
+    ``prefetch`` toggles the prefetch-overlap plane (lookahead pulls +
+    versioned row cache); the hot loop passes each step's NEXT batch as
+    the lookahead hint, exactly like a real data loader with one batch
+    of readahead.
     """
     from elasticdl_tpu.common.model_utils import get_model_spec
     from elasticdl_tpu.ps.parameter_server import ParameterServer
@@ -91,18 +98,23 @@ def run_ps_config(batches, steps, warmup, num_ps, pipelined, wire_dtype):
             client,
             embedding_inputs=spec.module.embedding_inputs,
             pipeline_pushes=pipelined,
+            prefetch_overlap=prefetch,
         )
         n_batches = len(batches)
         for i in range(warmup):
             f, l = batches[i % n_batches]
-            trainer.train_minibatch(f, l)
+            trainer.train_minibatch(
+                f, l, next_features=batches[(i + 1) % n_batches][0]
+            )
         trainer._flush_pushes()
         trainer.timing.reset()
         start = time.perf_counter()
         loss = None
         for i in range(steps):
             f, l = batches[i % n_batches]
-            _, _, loss = trainer.train_minibatch(f, l)
+            _, _, loss = trainer.train_minibatch(
+                f, l, next_features=batches[(i + 1) % n_batches][0]
+            )
         float(loss)
         trainer._flush_pushes()
         elapsed = time.perf_counter() - start
@@ -130,42 +142,60 @@ def run_ps_config(batches, steps, warmup, num_ps, pipelined, wire_dtype):
             s.stop()
 
 
-def cell_name(num_ps, pipelined, wire_dtype):
-    codec = "bf16" if wire_dtype == "bfloat16" else "f32"
-    return f"ps{num_ps}-{'overlapped' if pipelined else 'serial'}-{codec}"
+def cell_name(num_ps, pipelined, wire_dtype, prefetch=True):
+    codec = _CODEC_SHORT.get(wire_dtype, wire_dtype)
+    base = f"ps{num_ps}-{'overlapped' if pipelined else 'serial'}-{codec}"
+    return base if prefetch else f"{base}-nopf"
 
 
 def bench_ps_matrix(batch_size=16384, steps=6, warmup=4, repeats=3,
                     shard_counts=DEFAULT_SHARD_COUNTS,
                     codecs=DEFAULT_CODECS,
                     pipelining=DEFAULT_PIPELINING,
+                    prefetch_controls=None,
                     clock=None, seed=0):
-    """The full matrix. Budget-aware at two grains: a cell that no
-    longer fits is skipped (recorded as {"skipped": "budget"}), and a
-    cell mid-repeats stops early with the samples it has (marked
-    truncated). The cells that did run always report."""
+    """The full matrix (prefetch overlap ON everywhere), plus
+    ``prefetch_controls`` cells — (shards, pipelined, codec) configs
+    re-run with the prefetch-overlap plane off ("-nopf" suffix), so the
+    lookahead+cache win is a measured ratio, not an assumption. The
+    default control mirrors the strongest main-axis config. Budget-aware
+    at two grains: a cell that no longer fits is skipped (recorded as
+    {"skipped": "budget"}), and a cell mid-repeats stops early with the
+    samples it has (marked truncated). The cells that did run always
+    report."""
+    if prefetch_controls is None:
+        prefetch_controls = (
+            (max(shard_counts), True in pipelining, codecs[-1]),
+        )
     batches = make_batches(batch_size, seed=seed)
     cells = {}
     cell_cost_s = None
-    for num_ps in shard_counts:
-        for pipelined in pipelining:
-            for wire_dtype in codecs:
-                name = cell_name(num_ps, pipelined, wire_dtype)
-                if clock is not None and (
-                    clock.expired
-                    or (cell_cost_s and not clock.fits(cell_cost_s))
-                ):
-                    cells[name] = {"skipped": "budget"}
-                    continue
-                cell_start = time.perf_counter()
-                with flightrec.phase(f"ps_matrix:{name}"):
-                    cells[name] = _run_cell(
-                        batches, steps, warmup, num_ps, pipelined,
-                        wire_dtype, repeats, clock,
-                    )
-                # One completed cell calibrates the skip estimate for
-                # the rest (cells are roughly the same size).
-                cell_cost_s = time.perf_counter() - cell_start
+    configs = [
+        (num_ps, pipelined, wire_dtype, True)
+        for num_ps in shard_counts
+        for pipelined in pipelining
+        for wire_dtype in codecs
+    ] + [
+        (num_ps, pipelined, wire_dtype, False)
+        for num_ps, pipelined, wire_dtype in prefetch_controls
+    ]
+    for num_ps, pipelined, wire_dtype, prefetch in configs:
+        name = cell_name(num_ps, pipelined, wire_dtype, prefetch)
+        if clock is not None and (
+            clock.expired
+            or (cell_cost_s and not clock.fits(cell_cost_s))
+        ):
+            cells[name] = {"skipped": "budget"}
+            continue
+        cell_start = time.perf_counter()
+        with flightrec.phase(f"ps_matrix:{name}"):
+            cells[name] = _run_cell(
+                batches, steps, warmup, num_ps, pipelined,
+                wire_dtype, repeats, clock, prefetch,
+            )
+        # One completed cell calibrates the skip estimate for
+        # the rest (cells are roughly the same size).
+        cell_cost_s = time.perf_counter() - cell_start
     return {
         "axes": {
             "shards": list(shard_counts),
@@ -173,6 +203,10 @@ def bench_ps_matrix(batch_size=16384, steps=6, warmup=4, repeats=3,
                 "overlapped" if p else "serial" for p in pipelining
             ],
             "codec": list(codecs),
+            "prefetch_controls": [
+                cell_name(n, p, c, False)
+                for n, p, c in prefetch_controls
+            ],
         },
         "batch_size": batch_size,
         "steps_per_run": steps,
@@ -182,7 +216,7 @@ def bench_ps_matrix(batch_size=16384, steps=6, warmup=4, repeats=3,
 
 
 def _run_cell(batches, steps, warmup, num_ps, pipelined, wire_dtype,
-              repeats, clock):
+              repeats, clock, prefetch=True):
     runs = []
     truncated = False
     for i in range(repeats):
@@ -191,7 +225,8 @@ def _run_cell(batches, steps, warmup, num_ps, pipelined, wire_dtype,
             break
         runs.append(
             run_ps_config(
-                batches, steps, warmup, num_ps, pipelined, wire_dtype
+                batches, steps, warmup, num_ps, pipelined, wire_dtype,
+                prefetch,
             )
         )
     samples = [r["examples_per_sec"] for r in runs]

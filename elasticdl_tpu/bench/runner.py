@@ -314,10 +314,13 @@ def run_smoke(watchdog_s=None, budget_s=None, out_path=None,
                 batch_size=128, steps=2, warmup=1, num_ps=1, repeats=1,
                 clock=clock,
             ),
+            # float32 + int8 codecs: the int8 cell keeps the quantized
+            # packed wire (block codec + error feedback) covered in the
+            # <60 s path; no prefetch-off control at smoke scale.
             "ps_matrix_tiny": lambda: matrix.bench_ps_matrix(
                 batch_size=128, steps=2, warmup=1, repeats=1,
-                shard_counts=(1, 2), codecs=("float32",),
-                pipelining=(False,), clock=clock,
+                shard_counts=(1, 2), codecs=("float32", "int8"),
+                pipelining=(False,), prefetch_controls=(), clock=clock,
             ),
         }
     details = {}

@@ -257,6 +257,9 @@ def bench_deepfm_ps(batch_size=16384, steps=6, warmup=4, num_ps=2,
         ("serialized_bf16_wire", False, "bfloat16"),
         ("pipelined", True, "float32"),
         ("pipelined_bf16_wire", True, "bfloat16"),
+        # The quantized wire: int8 block-scaled dense grads (error
+        # feedback) + bf16 embedding legs, on the packed transport.
+        ("pipelined_int8_wire", True, "int8"),
     )
     out = {
         "repeats": repeats,
@@ -303,6 +306,11 @@ def bench_deepfm_ps(batch_size=16384, steps=6, warmup=4, num_ps=2,
             out["bf16_wire_speedup"] = speedup
             if flagged:
                 out["bf16_wire_speedup_contaminated"] = True
+        speedup, flagged = ratio("pipelined_int8_wire", "pipelined")
+        if speedup:
+            out["int8_wire_speedup"] = speedup
+            if flagged:
+                out["int8_wire_speedup_contaminated"] = True
     return out
 
 

@@ -50,11 +50,13 @@ def add_common_arguments(parser):
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
         "--ps_wire_dtype",
-        default="float32",
-        choices=["float32", "bfloat16"],
-        help="PS strategy: dtype for embedding values on the wire; "
-        "bfloat16 halves sparse pull/push bandwidth (dense params and "
-        "optimizer state stay float32 on the PS)",
+        default=None,
+        choices=["float32", "bfloat16", "int8"],
+        help="PS strategy wire codec: bfloat16 halves sparse pull/push "
+        "bandwidth; int8 additionally block-quantizes dense gradients "
+        "with error feedback (embedding legs stay bf16). Unset reads "
+        "ELASTICDL_WIRE_DTYPE (default float32); dense params and "
+        "optimizer state stay float32 on the PS either way.",
     )
     parser.add_argument(
         "--model_parallel_size",

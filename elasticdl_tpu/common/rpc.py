@@ -135,6 +135,14 @@ PSERVER_SERVICE = ServiceSpec(
             pb.IndexedSlices,
         ),
         "push_gradients": (pb.PushGradientsRequest, pb.PushGradientsResponse),
+        # Out-of-band transport: slim span header + one contiguous payload
+        # blob (clients may send a duck-typed tensor_utils.PackedPushRequest
+        # that appends the payload without copying it through a proto
+        # object — the Stub serializer is duck-typed for exactly this).
+        "push_gradients_packed": (
+            pb.PushGradientsPackedRequest,
+            pb.PushGradientsResponse,
+        ),
     },
 )
 
@@ -208,6 +216,13 @@ METHOD_POLICIES = {
     "pull_embedding_vectors": RetryPolicy(deadline=60.0),
     "pull_embedding_table": RetryPolicy(deadline=120.0),
     "push_gradients": RetryPolicy(
+        deadline=60.0, retryable_codes=_RETRYABLE_CONNECTIVITY
+    ),
+    # Same non-idempotence as push_gradients (a timed-out chunk may have
+    # landed and counted toward the reassembly), with the same deadline:
+    # chunking means each sub-request is bounded by THIS deadline instead
+    # of one giant push needing a one-off larger budget.
+    "push_gradients_packed": RetryPolicy(
         deadline=60.0, retryable_codes=_RETRYABLE_CONNECTIVITY
     ),
     # Collective service: a full model state pull during elastic regroup.
@@ -710,7 +725,12 @@ class Stub:
                 method,
                 channel.unary_unary(
                     f"/{spec.name}/{method}",
-                    request_serializer=req_cls.SerializeToString,
+                    # Duck-typed on purpose (not req_cls.SerializeToString):
+                    # out-of-band requests (tensor_utils.PackedPushRequest)
+                    # serialize themselves by joining the header with raw
+                    # payload views instead of round-tripping the bytes
+                    # through a proto message.
+                    request_serializer=lambda m: m.SerializeToString(),
                     response_deserializer=resp_cls.FromString,
                 ),
             )

@@ -20,6 +20,19 @@ which is exactly the reduce-scatter + all-gather decomposition of a ring
 allreduce with both wire legs quantized. Use `quantized_pmean` in
 shard_map-formulated DP steps; the GSPMD jit path keeps XLA's f32
 collectives (its allreduce is compiler-inserted and not user-swappable).
+
+PARTIAL-AUTO CAVEAT: when shard_map goes manual over the data axis only
+(the DP x TP composition — the model axis stays automatic so GSPMD keeps
+the Megatron collectives), XLA's SPMD partitioner cannot partition
+`all_to_all`/`all_gather` in the manual subgroup (fatal
+`IsManualSubgroup` check, observed through jax 0.4.x) — only the
+psum/pmax allreduce family survives. `quantized_pmean(...,
+collectives="psum_lanes")` reformulates for that regime: a shared
+per-block scale (one f32 pmax), int8-grid rounding, and ONE psum whose
+lanes are int16 (int32 past axis size 258) carrying the quantized
+values — 2 bytes on the wire per element instead of f32's 4, one
+quantization instead of two (the shared scale makes the sum exact on
+the int8 grid, so there is no second rounding on the return leg).
 """
 
 import jax
@@ -66,12 +79,37 @@ def quantized_psum_1d(x, axis_name):
     return _dequantize(gathered_q, gathered_s).reshape(-1)
 
 
-def quantized_pmean(tree, axis_name):
+def quantized_psum_lanes_1d(x, axis_name):
+    """Allreduce-sum a flat f32 [L] vector over `axis_name` using ONLY
+    psum/pmax collectives — the family the SPMD partitioner can handle
+    inside a PARTIAL-auto shard_map (see the module docstring's caveat).
+
+    One shared per-block scale travels as an f32 pmax; values round onto
+    the int8 grid and sum in int16 lanes (int32 once 127 * axis_size
+    would overflow int16). Because every replica quantizes with the SAME
+    scale, the summed grid values dequantize exactly: a single rounding
+    per element, where the all_to_all path rounds twice."""
+    n = jax.lax.psum(1, axis_name)
+    blocks = x.reshape(n, -1)  # same blocking granularity as above
+    absmax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    scale = jax.lax.pmax(absmax, axis_name) / 127.0 + _EPS
+    q = jnp.clip(jnp.round(blocks / scale), -127, 127)
+    lanes = jnp.int16 if 127 * n <= 32767 else jnp.int32
+    summed = jax.lax.psum(q.astype(lanes), axis_name)
+    return (summed.astype(jnp.float32) * scale).reshape(-1)
+
+
+def quantized_pmean(tree, axis_name, collectives="all_to_all"):
     """Mean-reduce a gradient pytree over `axis_name` with int8 wire
     payloads. Leaves are flattened into one vector (padded up to the axis
     size) so the per-block scales cover contiguous ranges, then restored.
     Error is bounded by the per-block max-abs / 127 rounding step — the
-    magnitude of stochastic-rounding noise, not a bias."""
+    magnitude of stochastic-rounding noise, not a bias.
+
+    collectives="all_to_all" is the full reduce-scatter/all-gather wire
+    (1 int8 byte per element per leg); "psum_lanes" is the partial-auto
+    safe formulation (2 bytes per element, single rounding) required when
+    the surrounding shard_map keeps other mesh axes automatic."""
     n = jax.lax.psum(1, axis_name)
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     sizes = [leaf.size for leaf in leaves]
@@ -84,7 +122,12 @@ def quantized_pmean(tree, axis_name):
         flat = jnp.concatenate(
             [flat, jnp.zeros(padded - total, jnp.float32)]
         )
-    summed = quantized_psum_1d(flat, axis_name) / n
+    reduce = (
+        quantized_psum_lanes_1d
+        if collectives == "psum_lanes"
+        else quantized_psum_1d
+    )
+    summed = reduce(flat, axis_name) / n
     out = []
     offset = 0
     for leaf, size in zip(leaves, sizes):

@@ -108,6 +108,11 @@ class PserverServicer:
         self._sync_window_timeout = sync_window_timeout
         self._push_workers = set()
         self._window_start = None
+        # Chunked packed pushes mid-reassembly: (worker, push_id) ->
+        # _PendingPush. Entries whose worker died mid-push are GC'd by
+        # age on the next packed push (CHUNK_GC_SECONDS).
+        self._chunk_lock = threading.Lock()
+        self._pending_chunks = {}
 
     # ---------- rpc methods (names match rpc.PSERVER_SERVICE) ----------
 
@@ -156,7 +161,7 @@ class PserverServicer:
         if table is None:
             raise ValueError(f"unknown embedding table {request.name!r}")
         if request.ids_bytes:
-            ids = np.frombuffer(request.ids_bytes, dtype=np.int64)
+            ids = tensor_utils.ids_from_bytes(request.ids_bytes)
         elif request.ids:
             ids = np.asarray(request.ids, dtype=np.int64)
         else:
@@ -191,10 +196,152 @@ class PserverServicer:
 
     def push_gradients(self, request, context):
         _PUSH_BYTES.labels(shard=self._shard).inc(request.ByteSize())
-        if self._use_async:
-            res = self._push_async(request)
+        dense, sparse = self._decode_model_pb(request.gradients)
+        return self._push_decoded(
+            dense,
+            sparse,
+            version=request.gradients.version,
+            worker_id_plus_one=request.worker_id_plus_one,
+            batch_size=request.batch_size,
+        )
+
+    def push_gradients_packed(self, request, context):
+        """Out-of-band push: spans decode as numpy views into the received
+        payload bytes — nothing is copied until the optimizer apply (int8
+        spans dequantize at decode, which IS their apply-side
+        materialization). Multi-chunk pushes buffer until every payload
+        byte arrived, then apply once."""
+        _PUSH_BYTES.labels(shard=self._shard).inc(request.ByteSize())
+        # Age-GC abandoned reassemblies on EVERY packed push: a worker
+        # that died mid-chunked-push must not pin its payload buffer
+        # until another CHUNKED push happens to arrive (single-chunk
+        # pushes are the common case). The sweep is O(pending), which
+        # is almost always zero.
+        self._gc_pending_chunks()
+        if request.chunk_count > 1:
+            assembled = self._absorb_chunk(request)
+            if assembled is None:
+                # Buffered; the reassembly-completing chunk reports the
+                # apply. accepted=True: the chunk itself was taken.
+                return pb.PushGradientsResponse(
+                    accepted=True, version=self._params.version
+                )
+            header, payload = assembled
         else:
-            res = self._push_sync(request)
+            header, payload = request, request.payload
+            if len(payload) != request.payload_total_bytes:
+                raise ValueError(
+                    f"packed push payload {len(payload)} bytes != "
+                    f"declared {request.payload_total_bytes} (truncated)"
+                )
+        dense, sparse = self._decode_packed(header, payload)
+        return self._push_decoded(
+            dense,
+            sparse,
+            version=header.version,
+            worker_id_plus_one=header.worker_id_plus_one,
+            batch_size=header.batch_size,
+        )
+
+    # ---------- packed decode / chunk reassembly ----------
+
+    def _decode_model_pb(self, model_pb):
+        """Legacy per-tensor proto model -> ({name: grad}, {table:
+        (values, ids)}) — the same decoded shape the packed path
+        produces, so both wire formats share one apply path."""
+        dense = {
+            t.name: tensor_utils.tensor_pb_to_ndarray(t)
+            for t in model_pb.dense_parameters
+        }
+        sparse = {
+            name: tensor_utils.indexed_slices_pb_to_ndarrays(slices)
+            for name, slices in model_pb.embedding_tables.items()
+        }
+        return dense, sparse
+
+    def _decode_packed(self, header, payload):
+        dense = {
+            span.name: tensor_utils.unpack_tensor_span(span, payload)
+            for span in header.dense
+        }
+        sparse = {
+            span.values.name: tensor_utils.unpack_slices_span(
+                span, payload
+            )
+            for span in header.sparse
+        }
+        return dense, sparse
+
+    CHUNK_GC_SECONDS = 120.0
+
+    def _gc_pending_chunks(self):
+        """Drop partial reassemblies older than CHUNK_GC_SECONDS (their
+        worker died mid-push); called on every packed push."""
+        now = time.monotonic()
+        with self._chunk_lock:
+            for k, entry in list(self._pending_chunks.items()):
+                if now - entry["created"] > self.CHUNK_GC_SECONDS:
+                    del self._pending_chunks[k]
+
+    def _absorb_chunk(self, request):
+        """Buffer one chunk; returns (header, payload) once the push is
+        complete, else None. Chunks may arrive in any order (each carries
+        its own payload_offset); headers ride chunk 0. Duplicate chunk
+        indexes (an UNAVAILABLE-retried sub-request whose first attempt
+        landed) are ignored rather than double-counted."""
+        key = (request.worker_id_plus_one, request.push_id)
+        now = time.monotonic()
+        with self._chunk_lock:
+            entry = self._pending_chunks.get(key)
+            if entry is None:
+                entry = self._pending_chunks[key] = {
+                    "buf": bytearray(request.payload_total_bytes),
+                    "received": 0,
+                    "seen": set(),
+                    "header": None,
+                    "created": now,
+                }
+            if request.chunk_index == 0:
+                entry["header"] = request
+            if request.chunk_index not in entry["seen"]:
+                entry["seen"].add(request.chunk_index)
+                start = request.payload_offset
+                end = start + len(request.payload)
+                if end > len(entry["buf"]):
+                    del self._pending_chunks[key]
+                    raise ValueError(
+                        f"packed chunk [{start}, {end}) outside the "
+                        f"declared {len(entry['buf'])}-byte payload"
+                    )
+                entry["buf"][start:end] = request.payload
+                entry["received"] += len(request.payload)
+            complete = (
+                entry["header"] is not None
+                and len(entry["seen"]) == request.chunk_count
+            )
+            if not complete:
+                return None
+            del self._pending_chunks[key]
+        if entry["received"] != len(entry["buf"]):
+            raise ValueError(
+                f"packed push reassembled {entry['received']} of "
+                f"{len(entry['buf'])} payload bytes (overlapping or "
+                f"truncated chunks)"
+            )
+        # The bytearray itself backs the decoded views (no final copy);
+        # it just left the pending map, so nothing mutates it anymore.
+        return entry["header"], entry["buf"]
+
+    # ---------- shared push entry ----------
+
+    def _push_decoded(self, dense, sparse, version, worker_id_plus_one,
+                      batch_size):
+        if self._use_async:
+            res = self._push_async(dense, sparse, version, batch_size)
+        else:
+            res = self._push_sync(
+                dense, sparse, version, worker_id_plus_one, batch_size
+            )
         _PUSHES.labels(
             outcome="accepted" if res.accepted else "rejected"
         ).inc()
@@ -202,10 +349,8 @@ class PserverServicer:
 
     # ---------- async path ----------
 
-    def _push_async(self, request):
-        staleness = max(
-            1, self._params.version - request.gradients.version
-        )
+    def _push_async(self, dense, sparse, version, batch_size):
+        staleness = max(1, self._params.version - version)
         if self._lr_staleness_modulation:
             self._opt.lr_modulator.set_multiplier(1.0 / staleness)
         # Applies serialize on the version lock: ctypes releases the GIL, so
@@ -215,10 +360,10 @@ class PserverServicer:
         with self._version_lock:
             start = time.perf_counter()
             with tracing.span("ps_apply_async"):
-                self._apply_model_pb(request.gradients)
+                self._apply_decoded(dense, sparse)
             apply_seconds = time.perf_counter() - start
             _APPLY_SECONDS.observe(apply_seconds)
-            self._params.total_records += request.batch_size
+            self._params.total_records += batch_size
             self._params.version += 1
             version = self._params.version
             snapshot = self._snapshot_if_due(version)
@@ -232,32 +377,31 @@ class PserverServicer:
 
     # ---------- sync path ----------
 
-    def _push_sync(self, request):
-        if request.worker_id_plus_one <= 0:
+    def _push_sync(self, dense, sparse, version, worker_id_plus_one,
+                   batch_size):
+        if worker_id_plus_one <= 0:
             raise ValueError(
                 "sync-mode gradient pushes must carry a worker_id; the "
                 "distinct-worker quorum cannot count anonymous pushes"
             )
         with self._version_lock:
             if (
-                request.gradients.version
+                version
                 < self._params.version - self._sync_version_tolerance
             ):
                 return pb.PushGradientsResponse(
                     accepted=False, version=self._params.version
                 )
-            for t in request.gradients.dense_parameters:
-                arr = tensor_utils.tensor_pb_to_ndarray(t).astype(
-                    np.float32, copy=False
-                )
-                if t.name in self._grad_sum:
-                    self._grad_sum[t.name] += arr
+            for name, g in dense.items():
+                if name in self._grad_sum:
+                    # += upcasts a bf16 addend; the accumulator is f32.
+                    self._grad_sum[name] += g
                 else:
-                    self._grad_sum[t.name] = arr
-            for name, slices in request.gradients.embedding_tables.items():
-                values, ids = tensor_utils.indexed_slices_pb_to_ndarrays(
-                    slices
-                )
+                    # Forced copy: packed-path grads are read-only views
+                    # into the received payload; the accumulator must own
+                    # a mutable f32 buffer.
+                    self._grad_sum[name] = np.array(g, dtype=np.float32)
+            for name, (values, ids) in sparse.items():
                 # bf16 wire payloads accumulate in f32 (precision of the
                 # merge must not depend on the wire dtype).
                 values = values.astype(np.float32, copy=False)
@@ -265,10 +409,10 @@ class PserverServicer:
                 acc[0].append(values)
                 acc[1].append(ids)
             self._grad_n += 1
-            self._params.total_records += request.batch_size
+            self._params.total_records += batch_size
             if self._window_start is None:
                 self._window_start = time.monotonic()
-            self._push_workers.add(request.worker_id_plus_one - 1)
+            self._push_workers.add(worker_id_plus_one - 1)
             quorum = len(self._push_workers)
             window_expired = (
                 time.monotonic() - self._window_start
@@ -322,27 +466,22 @@ class PserverServicer:
 
     # ---------- shared ----------
 
-    def _apply_model_pb(self, gradients):
+    def _apply_decoded(self, dense, sparse):
         # One optimizer step for the whole push: all params share the same
         # Adam bias-correction step (reference go/pkg/ps/optimizer.go:44).
         self._opt.begin_apply()
         try:
-            for t in gradients.dense_parameters:
-                param = self._params.dense.get(t.name)
+            for name, grad in dense.items():
+                param = self._params.dense.get(name)
                 if param is None:
                     raise ValueError(
-                        f"gradient for unknown parameter {t.name!r}"
+                        f"gradient for unknown parameter {name!r}"
                     )
-                self._opt.apply_dense(
-                    t.name, param, tensor_utils.tensor_pb_to_ndarray(t)
-                )
-            for name, slices in gradients.embedding_tables.items():
+                self._opt.apply_dense(name, param, grad)
+            for name, (values, ids) in sparse.items():
                 table = self._params.embedding_tables.get(name)
                 if table is None:
                     raise ValueError(f"gradient for unknown table {name!r}")
-                values, ids = tensor_utils.indexed_slices_pb_to_ndarrays(
-                    slices
-                )
                 self._opt.apply_sparse(table, ids, values)
         finally:
             self._opt.end_apply()

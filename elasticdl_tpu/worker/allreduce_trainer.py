@@ -44,6 +44,7 @@ rendezvous manager. TPU-first redesign:
   saving is the local chip count, not the global DP degree.
 """
 
+import itertools
 import threading
 import time
 
@@ -84,6 +85,17 @@ DEFAULT_MAX_COMM_RETRIES = 5
 # the reference similarly retried only Horovod comm errors
 # (allreduce_trainer.py:125-139).
 RETRYABLE_ERRORS = (grpc.RpcError, RuntimeError)
+
+# Per-instance salt for the compile tracker's mesh fingerprint. The
+# tracker's per-fn history is process-global (it must survive wrapper
+# rebuilds), so two trainer INSTANCES in one process — bench matrix
+# cells, back-to-back tests — would otherwise reproduce identical
+# `epochN:{axes}` tokens and have a fresh trainer's mesh change
+# misclassified as `rebuild` against the previous instance's history.
+# A monotonic counter (not id(): CPython reuses ids after GC) keeps
+# tokens unique across instances while staying constant within one, so
+# same-instance rebuild detection is unaffected.
+_trainer_seq = itertools.count(1)
 
 
 def join_gate_budget():
@@ -134,6 +146,7 @@ class AllReduceTrainer(JaxTrainer):
         context_parallel_model_fn=None,
     ):
         super().__init__(model, loss_fn, optimizer_spec, seed=seed)
+        self._mesh_salt = next(_trainer_seq)
         self._model_parallel_size = max(1, int(model_parallel_size or 1))
         self._param_specs_fn = param_specs_fn
         # Pipeline parallelism (parallel/pipeline.py): the model spec's
@@ -412,7 +425,8 @@ class AllReduceTrainer(JaxTrainer):
         from elasticdl_tpu.observability import profiling
 
         profiling.note_mesh(
-            f"epoch{resp.rendezvous_id}:{dict(self._mesh.shape)}",
+            f"t{self._mesh_salt}:epoch{resp.rendezvous_id}:"
+            f"{dict(self._mesh.shape)}",
             world_size=resp.world_size,
         )
         self._sharded_steps = {}
@@ -1091,7 +1105,16 @@ class AllReduceTrainer(JaxTrainer):
             if ZERO_AXIS in axes:
                 # Intra-host leg stays exact f32 on ICI.
                 grads = jax.lax.pmean(grads, ZERO_AXIS)
-            grads = quantized_pmean(grads, DATA_AXIS)
+            # Under TP the shard_map is PARTIAL-auto (model axis stays
+            # automatic) and the partitioner can only handle psum-family
+            # collectives in the manual subgroup — the all_to_all wire
+            # dies in a fatal IsManualSubgroup check (the bug behind the
+            # dp_tp_quantized drill's old xfail). psum_lanes keeps the
+            # DCN leg quantized (int8 grid in int16 lanes) there.
+            grads = quantized_pmean(
+                grads, DATA_AXIS,
+                collectives="psum_lanes" if tp else "all_to_all",
+            )
             loss = jax.lax.pmean(loss, axes)
             if new_state:
                 new_state = jax.lax.pmean(new_state, axes)

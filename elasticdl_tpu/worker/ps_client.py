@@ -8,18 +8,51 @@ in parallel; sparse grads are merged/deduplicated *before* the wire
 (ps_client.py:135-232).
 """
 
+import os
 import time
 
 import grpc
 import numpy as np
 
-from elasticdl_tpu.common import hash_utils, rpc, tensor_utils
+from elasticdl_tpu.common import hash_utils, knobs, rpc, tensor_utils
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.observability import emit_event, tracing
 from elasticdl_tpu.observability.metrics import default_registry
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
 logger = get_logger("worker.ps_client")
+
+
+class _PendingVectorPull:
+    """In-flight pull_embedding_vectors fan-out; result() harvests."""
+
+    def __init__(self, client, ids, futures, keep_wire_dtype):
+        self._client = client
+        self._ids = ids
+        self._futures = futures
+        self._keep_wire_dtype = keep_wire_dtype
+
+    def result(self):
+        out = None
+        for ps_id, (positions, f) in self._futures.items():
+            try:
+                result = f.result()
+            except grpc.RpcError as e:
+                # Embedding rows are REQUIRED for this batch — no partial
+                # answer is usable. Mark the shard and raise; the worker's
+                # minibatch retry ladder re-pulls once the shard returns.
+                self._client._mark_degraded(ps_id, e)
+                raise
+            self._client._mark_healthy(ps_id)
+            values = tensor_utils.tensor_pb_to_ndarray(result)
+            if values.dtype != np.float32 and not self._keep_wire_dtype:
+                values = values.astype(np.float32)
+            if out is None:
+                out = np.empty(
+                    (len(self._ids), values.shape[1]), dtype=values.dtype
+                )
+            out[positions] = values
+        return out
 
 _REG = default_registry()
 _DEGRADED = _REG.gauge(
@@ -32,21 +65,40 @@ _DROPPED_PUSHES = _REG.counter(
 
 
 class PSClient:
-    def __init__(self, ps_addrs, worker_id=-1, wire_dtype="float32"):
+    def __init__(self, ps_addrs, worker_id=-1, wire_dtype=None):
         """ps_addrs: list of "host:port", index = ps_id.
 
-        wire_dtype: dtype for embedding values on the wire ("float32" or
-        "bfloat16"). bf16 halves pull/push bandwidth for the sparse hot
-        path; dense parameters/gradients always travel f32 (they are small
-        and the optimizer moments live in f32). The reference kept its wire
-        f32 because its PS was never host-bandwidth-bound; a Python-process
-        PS is, so this is the EQuARX-analog lever for the PS strategy."""
-        if wire_dtype not in ("float32", "bfloat16"):
+        wire_dtype: wire codec, one of "float32" / "bfloat16" / "int8"
+        (None reads the ELASTICDL_WIRE_DTYPE knob). bf16 halves the
+        sparse hot path's pull/push bandwidth; int8 additionally
+        block-quantizes DENSE gradients (EQuARX-style absmax blocks,
+        ELASTICDL_WIRE_BLOCK_SIZE) with worker-side error-feedback
+        residuals so the quantization error stays out of the training
+        trajectory — embedding values/grads travel bf16 under int8
+        (per-id residuals for sparse rows would need a table-sized
+        shadow). Dense PARAMETER pulls always travel f32: the optimizer
+        moments live in f32 on the PS and params are pulled once per
+        model_steps, not per step."""
+        if wire_dtype is None or wire_dtype == "":
+            wire_dtype = knobs.get_str("ELASTICDL_WIRE_DTYPE")
+        if wire_dtype not in ("float32", "bfloat16", "int8"):
             raise ValueError(f"unsupported wire_dtype {wire_dtype!r}")
+        self.wire_dtype = wire_dtype
         # Public: the trainer keys its device-side dtype plumbing off
         # the wire dtype (bf16 rows/grads stay bf16 across the
-        # host<->device hop too).
-        self.bf16_wire = wire_dtype == "bfloat16"
+        # host<->device hop too). int8 keeps the bf16 embedding legs.
+        self.bf16_wire = wire_dtype in ("bfloat16", "int8")
+        self.int8_dense = wire_dtype == "int8"
+        self._block_size = knobs.get_int("ELASTICDL_WIRE_BLOCK_SIZE")
+        # Error-feedback residuals, one per dense grad name: what the
+        # last quantization rounded away, re-injected into the next push.
+        self._ef_residual = {}
+        # Packed-push chunking: sub-requests of one push share a push_id
+        # (salted by pid so anonymous workers on one host can't collide
+        # in the PS's reassembly map).
+        self._max_push_bytes = knobs.get_int("ELASTICDL_PS_MAX_PUSH_BYTES")
+        self._push_salt = (os.getpid() & 0xFFFFFFFF) << 24
+        self._push_seq = 0
         # Optional common.timing.Timing: when bound (the PS trainer binds
         # its own), push_gradients records its serialize/wire/apply
         # sub-phases there — the decomposition the microbench matrix and
@@ -291,6 +343,17 @@ class PSClient:
         defer the widening to the chip and move half the bytes across the
         host->device hop — which on tunnel-attached chips is the
         prefetch phase's actual limiter (tools/ps_push_probe.py)."""
+        pending = self.pull_embedding_vectors_async(
+            name, ids, keep_wire_dtype=keep_wire_dtype
+        )
+        return pending.result() if pending is not None else None
+
+    def pull_embedding_vectors_async(self, name, ids,
+                                     keep_wire_dtype=False):
+        """Issue the per-shard pull fan-out and return a handle whose
+        ``result()`` harvests it — the prefetch-overlap path issues these
+        for several tables (and for the NEXT batch) while the device is
+        still busy with the current step. Returns None for empty ids."""
         ids = np.asarray(ids, dtype=np.int64)
         if ids.size == 0:
             return None
@@ -302,33 +365,14 @@ class PSClient:
                 self._stubs[ps_id].pull_embedding_vectors.future(
                     pb.PullEmbeddingVectorsRequest(
                         name=name,
-                        ids_bytes=np.ascontiguousarray(shard_ids).tobytes(),
+                        ids_bytes=tensor_utils.ids_to_bytes(shard_ids),
                         value_dtype=value_dtype,
                     )
                 ),
             )
             for ps_id, (shard_ids, positions) in scattered.items()
         }
-        out = None
-        for ps_id, (positions, f) in futures.items():
-            try:
-                result = f.result()
-            except grpc.RpcError as e:
-                # Embedding rows are REQUIRED for this batch — no partial
-                # answer is usable. Mark the shard and raise; the worker's
-                # minibatch retry ladder re-pulls once the shard returns.
-                self._mark_degraded(ps_id, e)
-                raise
-            self._mark_healthy(ps_id)
-            values = tensor_utils.tensor_pb_to_ndarray(result)
-            if values.dtype != np.float32 and not keep_wire_dtype:
-                values = values.astype(np.float32)
-            if out is None:
-                out = np.empty(
-                    (len(ids), values.shape[1]), dtype=values.dtype
-                )
-            out[positions] = values
-        return out
+        return _PendingVectorPull(self, ids, futures, keep_wire_dtype)
 
     def pull_embedding_table(self, name, page_bytes=64 << 20, dim=None):
         """Every materialized (id, row) of a table, merged across shards —
@@ -383,53 +427,26 @@ class PSClient:
         push (feeds the checkpoint's exact consumed-record counter).
         Returns (accepted_all, max_version).
 
+        The push travels the PACKED wire (push_gradients_packed): a slim
+        span header plus one out-of-band payload assembled from zero-copy
+        views over the gradient arrays — no per-tensor tobytes, no proto
+        CopyFrom. Payloads over ELASTICDL_PS_MAX_PUSH_BYTES split into
+        chunked sub-requests so one giant embedding slice can't stall the
+        channel past its per-method deadline.
+
         Sub-span attribution (when ``self.timing`` is bound): the push
-        splits into push_serialize (host-side dedup + proto build),
-        push_apply (the slowest shard's optimizer apply, reported back
-        on PushGradientsResponse.apply_seconds — shards apply
-        concurrently, so the max is what gated the wait), and push_wire
-        (the remaining RPC wait: TCP + proto decode on both ends)."""
+        splits into push_serialize (host-side dedup + quantize + span
+        packing), push_apply (the slowest shard's optimizer apply,
+        reported back on PushGradientsResponse.apply_seconds — shards
+        apply concurrently, so the max is what gated the wait), and
+        push_wire (the remaining RPC wait: serialize-join, TCP, and
+        payload decode on both ends)."""
         serialize_start = time.perf_counter()
         with tracing.span("ps_push_serialize"):
-            dense_parts = self.partition_dense_names(dense_grads)
-            shard_models = {}
-
-            def model_for(ps_id):
-                if ps_id not in shard_models:
-                    shard_models[ps_id] = pb.Model(version=version)
-                return shard_models[ps_id]
-
-            for ps_id, names in dense_parts.items():
-                m = model_for(ps_id)
-                for name in names:
-                    m.dense_parameters.append(
-                        tensor_utils.ndarray_to_tensor_pb(
-                            np.ascontiguousarray(
-                                dense_grads[name], dtype=np.float32
-                            ),
-                            name,
-                        )
-                    )
-            for table, (values, ids) in sparse_grads.items():
-                values, ids = tensor_utils.deduplicate_indexed_slices(
-                    np.asarray(values, dtype=np.float32),
-                    np.asarray(ids, dtype=np.int64),
-                )
-                if self.bf16_wire:
-                    values = values.astype(tensor_utils.bfloat16)
-                for ps_id, (shard_ids, positions) in (
-                    hash_utils.scatter_embedding_ids(
-                        ids, self.num_ps
-                    ).items()
-                ):
-                    m = model_for(ps_id)
-                    m.embedding_tables[table].CopyFrom(
-                        tensor_utils.ndarray_to_indexed_slices_pb(
-                            np.ascontiguousarray(values[positions]),
-                            shard_ids,
-                            table,
-                        )
-                    )
+            requests = self._build_packed_requests(
+                dense_grads, sparse_grads, version, learning_rate,
+                batch_size,
+            )
         serialize_s = time.perf_counter() - serialize_start
         wait_start = time.perf_counter()
         apply_s = 0.0
@@ -437,41 +454,39 @@ class PSClient:
             futures = [
                 (
                     ps_id,
-                    self._stubs[ps_id].push_gradients.future(
-                        pb.PushGradientsRequest(
-                            gradients=m,
-                            learning_rate=learning_rate,
-                            worker_id_plus_one=(
-                                self._worker_id + 1
-                                if self._worker_id >= 0
-                                else 0
-                            ),
-                            batch_size=batch_size,
-                        )
-                    ),
+                    [
+                        self._stubs[ps_id].push_gradients_packed.future(r)
+                        for r in reqs
+                    ],
                 )
-                for ps_id, m in shard_models.items()
+                for ps_id, reqs in requests.items()
             ]
             accepted, max_version = True, 0
             delivered, last_err = 0, None
-            for ps_id, f in futures:
-                try:
-                    res = f.result()
-                except grpc.RpcError as e:
-                    # Degraded shard: drop its slice of this step's
-                    # gradients (async SGD tolerates a lost update the
-                    # same way it tolerates staleness) and keep the
-                    # healthy shards' updates. The worker keeps training
-                    # on work that doesn't need the dead shard.
-                    last_err = e
-                    self._mark_degraded(ps_id, e)
+            for ps_id, shard_futures in futures:
+                shard_err = None
+                for f in shard_futures:
+                    try:
+                        res = f.result()
+                    except grpc.RpcError as e:
+                        # Degraded shard: drop its slice of this step's
+                        # gradients (async SGD tolerates a lost update
+                        # the same way it tolerates staleness) and keep
+                        # the healthy shards' updates. A failed CHUNK
+                        # fails the whole shard slice — the PS GC's the
+                        # partial reassembly by age.
+                        shard_err = e
+                        break
+                    accepted = accepted and res.accepted
+                    max_version = max(max_version, res.version)
+                    apply_s = max(apply_s, res.apply_seconds)
+                if shard_err is not None:
+                    last_err = shard_err
+                    self._mark_degraded(ps_id, shard_err)
                     _DROPPED_PUSHES.inc()
                     continue
                 self._mark_healthy(ps_id)
                 delivered += 1
-                accepted = accepted and res.accepted
-                max_version = max(max_version, res.version)
-                apply_s = max(apply_s, res.apply_seconds)
         if self.timing is not None:
             wait_s = time.perf_counter() - wait_start
             self.timing.add("push_serialize", serialize_s)
@@ -483,3 +498,149 @@ class PSClient:
             # master's task retry accounting) sees it.
             raise last_err
         return accepted, max_version
+
+    def _build_packed_requests(self, dense_grads, sparse_grads, version,
+                               learning_rate, batch_size):
+        """{ps_id: [PackedPushRequest, ...]} for one gradient push.
+
+        Dense grads pack as f32 views (zero host copies) or, under the
+        int8 codec, as block-quantized spans with error feedback: the
+        residual the last quantization rounded away joins this step's
+        grad before quantizing, and the new round-off becomes the next
+        residual — the EQuARX recipe that keeps low-bit wire codecs from
+        biasing convergence. Sparse grads dedup once, then bucket by
+        id-sorted shard order with ONE gather for all shards — each
+        shard's rows are a contiguous block whose span is a view, where
+        the proto path gathered + copied per shard."""
+        worker_id_plus_one = (
+            self._worker_id + 1 if self._worker_id >= 0 else 0
+        )
+        headers, payloads = {}, {}
+
+        def ensure(ps_id):
+            if ps_id not in headers:
+                headers[ps_id] = pb.PushGradientsPackedRequest(
+                    version=version,
+                    learning_rate=learning_rate,
+                    worker_id_plus_one=worker_id_plus_one,
+                    batch_size=batch_size,
+                    chunk_count=1,
+                )
+                payloads[ps_id] = tensor_utils.PackedPayload()
+            return headers[ps_id], payloads[ps_id]
+
+        for ps_id, names in self.partition_dense_names(
+            dense_grads
+        ).items():
+            header, payload = ensure(ps_id)
+            for name in names:
+                arr = np.ascontiguousarray(
+                    dense_grads[name], dtype=np.float32
+                )
+                if self.int8_dense:
+                    residual = self._ef_residual.get(name)
+                    if residual is not None:
+                        arr = arr + residual
+                    q, scales = tensor_utils.quantize_int8_blocks(
+                        arr, self._block_size
+                    )
+                    dq = tensor_utils.dequantize_int8_blocks(
+                        q, scales, self._block_size
+                    ).reshape(arr.shape)
+                    self._ef_residual[name] = arr - dq
+                    header.dense.append(
+                        tensor_utils.pack_quantized_span(
+                            name, arr.shape, q, scales,
+                            self._block_size, payload,
+                        )
+                    )
+                else:
+                    header.dense.append(
+                        tensor_utils.pack_tensor_span(name, arr, payload)
+                    )
+        # Tables that share one input ids array (DeepFM wide/deep) dedup
+        # to identical id sets: the shard bucketing (lexsort + bounds) is
+        # computed once and reused across them.
+        bucket_memo = {}
+        for table, (values, ids) in sparse_grads.items():
+            memo_key = id(ids)
+            values, ids = tensor_utils.deduplicate_indexed_slices(
+                np.asarray(values, dtype=np.float32),
+                np.asarray(ids, dtype=np.int64),
+            )
+            if self.bf16_wire and values.dtype != tensor_utils.bfloat16:
+                values = values.astype(tensor_utils.bfloat16)
+            if self.num_ps == 1:
+                # One shard: no bucketing, no gather — the deduped
+                # values/ids ship as-is (spans are views over them).
+                header, payload = ensure(0)
+                header.sparse.append(
+                    tensor_utils.pack_slices_span(
+                        table, values, ids, payload
+                    )
+                )
+                continue
+            memo = bucket_memo.get(memo_key)
+            if memo is not None and np.array_equal(memo[0], ids):
+                ids_sorted, order, bounds = memo[1], memo[2], memo[3]
+            else:
+                shard = ids % self.num_ps
+                order = np.lexsort((ids, shard))
+                ids_sorted = ids[order]
+                bounds = np.searchsorted(
+                    shard[order], np.arange(self.num_ps + 1)
+                )
+                bucket_memo[memo_key] = (ids, ids_sorted, order, bounds)
+            values_sorted = values[order]
+            for ps_id in range(self.num_ps):
+                lo, hi = int(bounds[ps_id]), int(bounds[ps_id + 1])
+                if lo == hi:
+                    continue
+                header, payload = ensure(ps_id)
+                header.sparse.append(
+                    tensor_utils.pack_slices_span(
+                        table, values_sorted[lo:hi], ids_sorted[lo:hi],
+                        payload,
+                    )
+                )
+        requests = {}
+        for ps_id, header in headers.items():
+            payload = payloads[ps_id]
+            header.payload_total_bytes = payload.nbytes
+            max_bytes = self._max_push_bytes
+            if max_bytes <= 0 or payload.nbytes <= max_bytes:
+                requests[ps_id] = [
+                    tensor_utils.PackedPushRequest(
+                        header, payload.parts, payload.nbytes
+                    )
+                ]
+                continue
+            n_chunks = -(-payload.nbytes // max_bytes)
+            self._push_seq += 1
+            push_id = self._push_salt | (self._push_seq & 0xFFFFFF)
+            header.push_id = push_id
+            header.chunk_count = n_chunks
+            reqs = []
+            for i in range(n_chunks):
+                start = i * max_bytes
+                end = min(start + max_bytes, payload.nbytes)
+                if i == 0:
+                    chunk_header = header  # spans ride the first chunk
+                else:
+                    chunk_header = pb.PushGradientsPackedRequest(
+                        worker_id_plus_one=worker_id_plus_one,
+                        push_id=push_id,
+                        chunk_index=i,
+                        chunk_count=n_chunks,
+                        payload_offset=start,
+                        payload_total_bytes=payload.nbytes,
+                    )
+                reqs.append(
+                    tensor_utils.PackedPushRequest(
+                        chunk_header,
+                        payload.slice_parts(start, end),
+                        end - start,
+                    )
+                )
+            requests[ps_id] = reqs
+        return requests

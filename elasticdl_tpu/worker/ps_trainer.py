@@ -42,6 +42,14 @@ logger = get_logger("worker.ps_trainer")
 
 DEFAULT_MAX_PUSH_RETRIES = 3
 
+def _unique_inverse(flat):
+    """np.unique(flat, return_inverse=True) in the ids' NATIVE dtype —
+    sorting 640k int32 ids costs ~2/3 of sorting their int64 widening
+    (measured; a bitmap + rank-cumsum alternative measured slower) —
+    with the unique set widened to the int64 the wire contract needs."""
+    unique, inverse = np.unique(flat, return_inverse=True)
+    return np.ascontiguousarray(unique, dtype=np.int64), inverse
+
 
 class ParameterServerTrainer(JaxTrainer):
     def __init__(
@@ -58,6 +66,7 @@ class ParameterServerTrainer(JaxTrainer):
         seed=0,
         pipeline_pushes=None,
         model_steps=1,
+        prefetch_overlap=None,
     ):
         super().__init__(model, loss_fn, optimizer_spec, seed=seed)
         self._ps = ps_client
@@ -95,6 +104,31 @@ class ParameterServerTrainer(JaxTrainer):
         self._pipeline_pushes = pipeline_pushes and use_async
         self._push_executor = None
         self._push_future = None
+        # Prefetch overlap (async SGD only — sync mode's exactness
+        # contract excludes stale rows): the embedding lookup leaves the
+        # critical path two ways. (1) Lookahead: when the caller passes
+        # next_features, the NEXT batch's pull RPCs are issued right
+        # after this step dispatches, so they run while the device
+        # computes. (2) A versioned row cache (worker/row_cache.py)
+        # serves recently pulled rows within a bounded version-staleness
+        # budget — the same staleness class the pipelined push already
+        # introduces. Both default on via ELASTICDL_PREFETCH_DEPTH /
+        # ELASTICDL_PREFETCH_CACHE_ROWS.
+        if prefetch_overlap is None:
+            prefetch_overlap = (
+                knobs.get_int("ELASTICDL_PREFETCH_DEPTH") > 0
+            )
+        self._prefetch_overlap = bool(prefetch_overlap) and use_async
+        self._row_cache = None
+        if (
+            self._prefetch_overlap
+            and knobs.get_int("ELASTICDL_PREFETCH_CACHE_ROWS") > 0
+        ):
+            from elasticdl_tpu.worker.row_cache import EmbeddingRowCache
+
+            self._row_cache = EmbeddingRowCache()
+        # One lookahead prefetch in flight: (features object, handle).
+        self._pending_prefetch = None
         # get_model_steps (reference worker.py:314-327): pull fresh PS
         # params only every N training minibatches; in between, train
         # with the LOCAL model — gradients apply locally through the
@@ -350,31 +384,108 @@ class ParameterServerTrainer(JaxTrainer):
                 {k: jnp.asarray(v) for k, v in named.items()},
             )
         self._version = max(self._version, version)
+        if self._row_cache is not None:
+            self._row_cache.note_version(self._version)
         # Reset the local-training cadence only on a SUCCESSFUL pull: a
         # transient PS failure must not suppress re-pull attempts for the
         # next model_steps-1 minibatches.
         self._since_pull = 1
 
-    def _prefetch_embeddings(self, features):
+    def _start_prefetch(self, features, use_cache=True):
+        """Issue the embedding pulls for one batch WITHOUT waiting.
+
+        Per table: dedup the batch's ids, serve what the row cache can
+        (within its staleness budget), and fire the pull RPC fan-out for
+        the misses only. Returns an opaque handle for _finish_prefetch.
+        The split is the overlap point: between start and finish the
+        caller runs the dense pull — or, on the lookahead path, the
+        whole previous step's device compute."""
+        if not self._embedding_dims:
+            return {}
+        cache = self._row_cache if use_cache else None
+        handle = {}
+        # Tables often key off the SAME ids array (DeepFM's wide/deep
+        # share one id space); dedup that work once per distinct array.
+        uniq_memo = {}
+        for table, ids in self._embedding_inputs(features).items():
+            memo_key = id(ids)
+            if memo_key in uniq_memo:
+                flat, unique, inverse = uniq_memo[memo_key]
+            else:
+                # flat keeps the feature dtype (int32 ids sort faster);
+                # the push path widens to int64 at the wire boundary.
+                flat = np.asarray(ids).reshape(-1)
+                unique, inverse = _unique_inverse(flat)
+                uniq_memo[memo_key] = (flat, unique, inverse)
+            hit, cached_rows = (None, None)
+            miss_ids = unique
+            if cache is not None:
+                hit, cached_rows = cache.lookup(table, unique)
+                miss_ids = unique[~hit]
+            # bf16 wire: pull the rows AS bf16 and widen on the chip
+            # (exact) — half the bytes across the host->device hop.
+            pending = None
+            if miss_ids.size:
+                pending = self._ps.pull_embedding_vectors_async(
+                    table, miss_ids, keep_wire_dtype=self._bf16_wire
+                )
+            handle[table] = (
+                flat, unique, inverse, hit, cached_rows, miss_ids, pending
+            )
+        return handle
+
+    def _finish_prefetch(self, handle, use_cache=True):
+        """Harvest a _start_prefetch handle -> (rows pytree, flat_ids).
+        Pulled miss rows enter the row cache stamped with the current
+        version."""
+        cache = self._row_cache if use_cache else None
+        by_path, flat_ids = {}, {}
+        for table, (
+            flat, unique, inverse, hit, cached_rows, miss_ids, pending
+        ) in handle.items():
+            pulled = pending.result() if pending is not None else None
+            if hit is None:  # cache not in play
+                rows = pulled
+            else:
+                if cache is not None and pulled is not None:
+                    cache.insert(table, miss_ids, pulled)
+                if pulled is None:
+                    rows = cached_rows  # every id hit, in unique order
+                elif cached_rows is None:
+                    rows = pulled  # every id missed
+                else:
+                    rows = np.empty(
+                        (unique.size,) + pulled.shape[1:], pulled.dtype
+                    )
+                    rows[hit] = cached_rows
+                    rows[~hit] = pulled
+            by_path[self._embedding_paths[table]] = jnp.asarray(
+                rows[inverse]
+            )
+            flat_ids[table] = flat
+        return _nest_at(by_path), flat_ids
+
+    def _prefetch_embeddings(self, features, use_cache=True):
         """features -> (rows {table: [n_positions, dim]}, flat_ids
         {table: [n_positions]}). Pulls unique ids only; expands back by
-        inverse so the in-jit layer does a plain reshape."""
+        inverse so the in-jit layer does a plain reshape. (The blocking
+        wrapper over _start/_finish_prefetch — eval uses it.)"""
         if not self._embedding_dims:
             return {}, {}
-        by_path, flat_ids = {}, {}
-        for table, ids in self._embedding_inputs(features).items():
-            ids = np.asarray(ids, dtype=np.int64).reshape(-1)
-            unique, inverse = np.unique(ids, return_inverse=True)
-            # bf16 wire: upload the rows AS bf16 and widen on the chip
-            # (exact) — half the bytes across the host->device hop.
-            pulled = self._ps.pull_embedding_vectors(
-                table, unique, keep_wire_dtype=self._bf16_wire
-            )
-            by_path[self._embedding_paths[table]] = jnp.asarray(
-                pulled[inverse]
-            )
-            flat_ids[table] = ids
-        return _nest_at(by_path), flat_ids
+        return self._finish_prefetch(
+            self._start_prefetch(features, use_cache=use_cache),
+            use_cache=use_cache,
+        )
+
+    def _take_pending_prefetch(self, features):
+        """The lookahead handle issued for `features` last step, if the
+        caller's hint matched (object identity — the hot loops hand the
+        same batch objects back); a mismatch discards the handle (its
+        futures complete harmlessly server-side)."""
+        pending, self._pending_prefetch = self._pending_prefetch, None
+        if pending is not None and pending[0] is features:
+            return pending[1]
+        return None
 
     # ---------- jitted steps ----------
 
@@ -408,6 +519,11 @@ class ParameterServerTrainer(JaxTrainer):
             # Differentiating through the bf16->f32 widen makes the row
             # cotangents come out bf16 automatically: the device casts,
             # and device_get in the push moves half the bytes.
+            # (Design note: expanding unique rows by the batch inverse
+            # INSIDE the jit — so the backward would segment-sum the
+            # cotangents into pre-deduped [n_unique, dim] grads — was
+            # tried and reverted: XLA's scatter-add costs ~5x the native
+            # hash dedup on a CPU host. Host-side dedup stays.)
             (loss, new_state), grads = jax.value_and_grad(
                 loss_of, argnums=(0, 1), has_aux=True
             )(params, emb_rows)
@@ -479,6 +595,11 @@ class ParameterServerTrainer(JaxTrainer):
                 batch_size=batch_size,
             )
         self._version = max(self._version, version)
+        if self._row_cache is not None:
+            # Our apply bumped the PS clock: age the cache so rows drop
+            # out once they exceed the staleness budget. (Thread-safe —
+            # this runs on the push thread in pipelined mode.)
+            self._row_cache.note_version(self._version)
         return accepted, version
 
     def _flush_pushes(self):
@@ -489,13 +610,23 @@ class ParameterServerTrainer(JaxTrainer):
         if future is not None:
             future.result()
 
-    def train_minibatch(self, features, labels):
+    def train_minibatch(self, features, labels, next_features=None):
+        """next_features: optional hint — the NEXT batch the caller will
+        train on. With prefetch overlap on (async pipelined mode), its
+        embedding pulls are issued while this step's device compute and
+        push run, taking the lookup off the next call's critical path."""
         self.init_variables_if_needed(features)
         if self._pipeline_pushes:
-            return self._train_minibatch_pipelined(features, labels)
+            return self._train_minibatch_pipelined(
+                features, labels, next_features
+            )
         device_features = _to_device_batch(features)
         device_labels = _to_device_batch(labels)
         for attempt in range(self._max_push_retries):
+            # Issue the embedding pulls BEFORE the dense pull waits:
+            # both fan-outs ride the wire together instead of in series.
+            with self.timing.record("prefetch_issue"):
+                handle = self._start_prefetch(features)
             with self.timing.record("pull_model"):
                 if attempt == 0:
                     self._maybe_sync_model()
@@ -505,7 +636,7 @@ class ParameterServerTrainer(JaxTrainer):
                     # the local-training cadence.
                     self._sync_model()
             with self.timing.record("prefetch_embeddings"):
-                emb_rows, flat_ids = self._prefetch_embeddings(features)
+                emb_rows, flat_ids = self._finish_prefetch(handle)
             self._rng, step_rng = jax.random.split(self._rng)
             state = {
                 k: v for k, v in self._variables.items() if k != "params"
@@ -549,12 +680,14 @@ class ParameterServerTrainer(JaxTrainer):
             )
         return False, self._version, loss
 
-    def _train_minibatch_pipelined(self, features, labels):
-        """Async-SGD step with the push off the critical path: while the
-        device still computes step N, this thread already pulls params and
-        prefetches embeddings for step N+1 — the reference's hot loop
-        serializes a pull, a mid-forward lookup RPC, the step, and the
-        push (ps_trainer.py:372-401)."""
+    def _train_minibatch_pipelined(self, features, labels,
+                                   next_features=None):
+        """Async-SGD step with the push AND the embedding lookup off the
+        critical path: while the device still computes step N, this
+        thread already pulls params for step N+1, and step N+1's
+        embedding pulls were issued LAST call (lookahead) — the
+        reference's hot loop serializes a pull, a mid-forward lookup
+        RPC, the step, and the push (ps_trainer.py:372-401)."""
         import concurrent.futures
 
         if self._push_executor is None:
@@ -564,10 +697,14 @@ class ParameterServerTrainer(JaxTrainer):
         device_features = _to_device_batch(features)
         device_labels = _to_device_batch(labels)
         # These RPCs overlap the PREVIOUS step's device compute.
+        handle = self._take_pending_prefetch(features)
+        if handle is None:
+            with self.timing.record("prefetch_issue"):
+                handle = self._start_prefetch(features)
         with self.timing.record("pull_model"):
             self._maybe_sync_model()
         with self.timing.record("prefetch_embeddings"):
-            emb_rows, flat_ids = self._prefetch_embeddings(features)
+            emb_rows, flat_ids = self._finish_prefetch(handle)
         self._rng, step_rng = jax.random.split(self._rng)
         state = {
             k: v for k, v in self._variables.items() if k != "params"
@@ -602,6 +739,14 @@ class ParameterServerTrainer(JaxTrainer):
             self._version,
             int(np.asarray(labels).shape[0]),
         )
+        # Lookahead: issue the NEXT batch's embedding pulls now — they
+        # ride the wire while this step's device compute and push finish,
+        # so the next call's prefetch phase is just a harvest.
+        if self._prefetch_overlap and next_features is not None:
+            with self.timing.record("prefetch_issue"):
+                self._pending_prefetch = (
+                    next_features, self._start_prefetch(next_features)
+                )
         # Lazy loss: materializing here would re-serialize the pipeline.
         return True, self._version, loss
 
@@ -609,7 +754,9 @@ class ParameterServerTrainer(JaxTrainer):
         self.init_variables_if_needed(features)
         self._flush_pushes()  # read-your-writes for the eval pull
         self._sync_model()
-        emb_rows, _ = self._prefetch_embeddings(features)
+        # use_cache=False: eval reads the freshest rows — the bounded
+        # staleness the training loop absorbs has no place in metrics.
+        emb_rows, _ = self._prefetch_embeddings(features, use_cache=False)
         state = {k: v for k, v in self._variables.items() if k != "params"}
         outputs = self._ps_forward(
             self._variables["params"],

@@ -422,6 +422,103 @@ def test_proto_drift_real_pb2_matches_real_proto():
 
 
 # ---------------------------------------------------------------------------
+# wire-codec
+# ---------------------------------------------------------------------------
+
+
+def test_wire_codec_flags_raw_bytes_in_proto_facing_modules(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "elasticdl_tpu/ps/sneaky.py": """
+            import numpy as np
+            from numpy import frombuffer
+
+            from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+            def encode(arr):
+                return pb.Tensor(content=arr.tobytes())
+
+            def decode(request):
+                a = np.frombuffer(request.content, dtype=np.float32)
+                b = frombuffer(request.ids_bytes, dtype=np.int64)
+                return a, b
+            """,
+        },
+    )
+    got = keys(run_rule(project, "wire-codec"))
+    assert got == {"tobytes", "frombuffer"}
+    # Both frombuffer spellings (np.frombuffer + the bare import) flag.
+    lines = [
+        f.line
+        for f in run_rule(project, "wire-codec")
+        if f.key == "frombuffer"
+    ]
+    assert len(lines) == 2, lines
+
+
+def test_wire_codec_exempts_codec_home_and_non_proto_modules(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            # The codec home itself is the ONE sanctioned location.
+            "elasticdl_tpu/common/tensor_utils.py": """
+            import numpy as np
+
+            from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+            def ids_to_bytes(ids):
+                return np.ascontiguousarray(ids).tobytes()
+
+            def ids_from_bytes(buf):
+                return np.frombuffer(buf, dtype=np.int64)
+            """,
+            # Binary file IO far from the proto surface stays legal.
+            "elasticdl_tpu/data/gen/reader.py": """
+            import numpy as np
+
+            def load(raw):
+                return np.frombuffer(raw, dtype=np.uint8)
+            """,
+            # Proto-facing code that routes through tensor_utils: clean.
+            "elasticdl_tpu/worker/fine.py": """
+            from elasticdl_tpu.common import tensor_utils
+            from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+            def encode(ids):
+                return pb.PullEmbeddingVectorsRequest(
+                    ids_bytes=tensor_utils.ids_to_bytes(ids)
+                )
+            """,
+        },
+    )
+    assert run_rule(project, "wire-codec") == []
+
+
+def test_wire_codec_suppression(tmp_path):
+    project = make_project(
+        tmp_path,
+        {
+            "elasticdl_tpu/master/special.py": """
+            import numpy as np
+
+            from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+            def checksum(arr):
+                # edl-lint: disable=wire-codec
+                return hash(arr.tobytes())
+            """,
+        },
+    )
+    assert run_rule(project, "wire-codec") == []
+
+
+def test_wire_codec_real_tree_clean():
+    project = Project.load(REPO)
+    assert run_rule(project, "wire-codec") == []
+
+
+# ---------------------------------------------------------------------------
 # rpc-deadlines / metric-names (ported rules)
 # ---------------------------------------------------------------------------
 

@@ -148,22 +148,18 @@ def test_kill_worker_mid_job_drill(tmp_path, strategy, num_ps):
         # composition (multi-host data axis, intra-host model axis) with
         # the cross-process gradient mean quantized — the exact DCN leg
         # EQuARX targets — surviving a SIGKILL regroup.
-        pytest.param(
+        # Un-xfailed: the "never starts on 1-core boxes" diagnosis was
+        # wrong — workers were SIGABRTing in a fatal XLA SPMD-partitioner
+        # check (all_to_all/all_gather are unpartitionable inside a
+        # partial-auto shard_map through jax 0.4.x), which the master's
+        # relaunch loop made look like a startup stall. The TP variant
+        # now reduces through quantized_pmean's psum-lane formulation
+        # (parallel/quantized.py), which that partitioner regime handles.
+        (
             "dp_tp_quantized",
             ("--model_parallel_size", "2", "--quantized_grads"),
             {},
             "'model': 2",
-            marks=pytest.mark.xfail(
-                strict=False,
-                reason="pre-existing: the heaviest variant (multihost x "
-                "TP x quantized collectives) never starts making "
-                "progress within the drill budget on 1-core CI boxes; "
-                "passes where 2 cores are available. Tracked by the "
-                "ROADMAP 'quantized transport' item — the quantized "
-                "allreduce rework should also cut its startup lowering "
-                "cost. strict=False so a fast box's pass doesn't fail "
-                "the suite.",
-            ),
         ),
         # DP x PIPELINE across processes: the stage axis (2) lives inside
         # each 4-device process (same composition invariant as dp_tp),

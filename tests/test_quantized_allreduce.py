@@ -77,6 +77,40 @@ def test_quantized_pmean_tree_roundtrip():
             )
 
 
+def test_quantized_pmean_psum_lanes_partial_auto():
+    """The psum-lane formulation: (a) numerically tracks the exact mean
+    within one int8 rounding step, (b) compiles inside a PARTIAL-auto
+    shard_map (manual data axis, automatic model axis) — where the
+    all_to_all wire hits a fatal SPMD-partitioner check, the crash behind
+    the dp_tp_quantized drill's old xfail."""
+    devices = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devices, ("data", "model"))
+    rng = np.random.default_rng(3)
+    tree = {
+        "w": rng.normal(size=(4, 8, 6)).astype(np.float32),
+        "b": rng.normal(size=(4, 10)).astype(np.float32),
+    }
+
+    def body(t):
+        local = jax.tree_util.tree_map(lambda a: a[0], t)
+        out = quantized_pmean(local, "data", collectives="psum_lanes")
+        return jax.tree_util.tree_map(lambda a: a[None], out)
+
+    specs = jax.tree_util.tree_map(lambda _: P("data"), tree)
+    with mesh:
+        got = jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(specs,), out_specs=specs,
+            check_vma=False, axis_names={"data"},
+        ))(tree)
+    for key in tree:
+        want = tree[key].mean(axis=0)
+        step = np.abs(tree[key]).max() / 127.0
+        for r in range(4):
+            np.testing.assert_allclose(
+                np.asarray(got[key])[r], want, atol=step + 1e-6
+            )
+
+
 @pytest.mark.slow
 def test_dp_training_with_quantized_gradients_converges():
     """Explicit-gradient DP step: per-shard grads, quantized-allreduce
@@ -361,7 +395,6 @@ def test_quantized_grads_on_multihost_zero1_mesh():
         assert b == pytest.approx(a, rel=0.15), (exact, quant)
 
 
-@pytest.mark.slow
 def test_trainer_quantized_grads_compose_with_tp():
     """--quantized_grads --model_parallel_size 2 (VERDICT r4 #5): the
     data-axis mean of model-sharded grads quantizes while the model-axis
@@ -369,9 +402,10 @@ def test_trainer_quantized_grads_compose_with_tp():
     within int8 noise, still converging, with the model axis really
     formed (no silent fallback or warn-and-ignore).
 
-    slow: like the DP convergence test above, this DP x TP quantized
-    compile wedges/aborts XLA on a 1-core CPU host — keep it out of the
-    wall-clock-capped tier-1 lane."""
+    (Previously slow-marked as "wedges/aborts XLA": the abort was the
+    SPMD partitioner's fatal IsManualSubgroup check on all_to_all inside
+    a partial-auto shard_map; the TP variant now reduces through
+    quantized_pmean's psum-lane formulation and compiles in seconds.)"""
     import tests.test_module as test_module
     from elasticdl_tpu.worker.allreduce_trainer import AllReduceTrainer
     from elasticdl_tpu.worker.master_client import MasterClient

@@ -81,6 +81,10 @@ class FlakyPserver:
         self._maybe_fail("push_gradients", context)
         return pb.PushGradientsResponse(accepted=True, version=8)
 
+    def push_gradients_packed(self, request, context):
+        self._maybe_fail("push_gradients_packed", context)
+        return pb.PushGradientsResponse(accepted=True, version=8)
+
 
 def _counter_value(name, **labels):
     metric = default_registry().get(name)

@@ -1,0 +1,436 @@
+"""The zero-copy quantized PS wire: int8 block-scaled codec round trips,
+out-of-band packed transport (incl. chunked streaming + truncated-payload
+rejection), error feedback through a real PS, and the worker's versioned
+embedding row cache."""
+
+import numpy as np
+import pytest
+
+import embedding_test_module
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.ops import optimizers
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.ps.parameter_server import ParameterServer
+from elasticdl_tpu.worker.ps_client import PSClient
+from elasticdl_tpu.worker.row_cache import EmbeddingRowCache
+
+
+# ---------------------------------------------------------------------------
+# int8 block-scaled codec
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("block", [1, 7, 64, 256])
+@pytest.mark.parametrize("n", [0, 1, 5, 256, 1000])
+def test_int8_roundtrip_error_bound(block, n):
+    """Per-element round-trip error is at most scale/2 where scale is the
+    element's block absmax / 127 — the codec's pinned contract."""
+    rng = np.random.default_rng(n * 1000 + block)
+    x = (rng.normal(size=n) * rng.uniform(0.01, 100)).astype(np.float32)
+    q, scales = tensor_utils.quantize_int8_blocks(x, block)
+    assert q.dtype == np.int8 and scales.dtype == np.float32
+    assert q.size == n and scales.size == -(-n // block) if n else True
+    dq = tensor_utils.dequantize_int8_blocks(q, scales, block)
+    per_element_scale = np.repeat(scales, block)[:n]
+    assert np.all(np.abs(dq - x) <= per_element_scale / 2 + 1e-12)
+
+
+def test_int8_zero_blocks_and_shapes():
+    # All-zero blocks decode to exact zeros (scale 0, no division).
+    q, scales = tensor_utils.quantize_int8_blocks(np.zeros(300), 256)
+    assert np.all(scales == 0)
+    np.testing.assert_array_equal(
+        tensor_utils.dequantize_int8_blocks(q, scales, 256), np.zeros(300)
+    )
+    # Multi-dim input flattens row-major; caller owns the reshape.
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    q, scales = tensor_utils.quantize_int8_blocks(x, 4)
+    dq = tensor_utils.dequantize_int8_blocks(q, scales, 4).reshape(3, 4)
+    assert np.max(np.abs(dq - x)) <= np.max(np.abs(x)) / 127 / 2 + 1e-6
+
+
+def test_int8_codec_validation():
+    with pytest.raises(ValueError, match="block_size"):
+        tensor_utils.quantize_int8_blocks(np.ones(4), 0)
+    with pytest.raises(ValueError, match="block_size"):
+        tensor_utils.dequantize_int8_blocks(
+            np.ones(4, np.int8), np.ones(1, np.float32), -1
+        )
+    with pytest.raises(ValueError, match="scales"):
+        tensor_utils.dequantize_int8_blocks(
+            np.ones(300, np.int8), np.ones(1, np.float32), 256
+        )
+
+
+# ---------------------------------------------------------------------------
+# out-of-band packed transport
+# ---------------------------------------------------------------------------
+
+
+def _roundtrip(header, payload):
+    """Client-side wire bytes -> server-side parsed request."""
+    req = tensor_utils.PackedPushRequest(
+        header, payload.parts, payload.nbytes
+    )
+    return pb.PushGradientsPackedRequest.FromString(req.SerializeToString())
+
+
+def test_packed_spans_roundtrip_all_dtypes():
+    payload = tensor_utils.PackedPayload()
+    header = pb.PushGradientsPackedRequest(version=3, batch_size=16)
+    f32 = np.arange(12, dtype=np.float32).reshape(3, 4)
+    bf16 = np.linspace(-2, 2, 8).astype(tensor_utils.bfloat16).reshape(2, 4)
+    header.dense.append(tensor_utils.pack_tensor_span("w", f32, payload))
+    header.dense.append(tensor_utils.pack_tensor_span("h", bf16, payload))
+    # Quantized span via the wire_dtype switch.
+    big = np.random.default_rng(0).normal(size=(4, 64)).astype(np.float32)
+    header.dense.append(
+        tensor_utils.pack_tensor_span(
+            "q", big, payload, wire_dtype="int8", block_size=32
+        )
+    )
+    values = np.ones((3, 4), np.float32) * 2
+    ids = np.array([5, 9, 11], np.int64)
+    header.sparse.append(
+        tensor_utils.pack_slices_span("emb", values, ids, payload)
+    )
+    header.payload_total_bytes = payload.nbytes
+
+    parsed = _roundtrip(header, payload)
+    assert parsed.version == 3 and len(parsed.payload) == payload.nbytes
+    buf = parsed.payload
+    out = {s.name: tensor_utils.unpack_tensor_span(s, buf)
+           for s in parsed.dense}
+    np.testing.assert_array_equal(out["w"], f32)
+    np.testing.assert_array_equal(
+        out["h"].astype(np.float32), bf16.astype(np.float32)
+    )
+    assert np.max(np.abs(out["q"] - big)) <= np.abs(big).max() / 127
+    got_values, got_ids = tensor_utils.unpack_slices_span(
+        parsed.sparse[0], buf
+    )
+    np.testing.assert_array_equal(got_values, values)
+    np.testing.assert_array_equal(got_ids, ids)
+    # Zero-copy contract: unquantized spans are VIEWS into the received
+    # bytes, not copies.
+    assert out["w"].base is not None
+
+
+def test_packed_truncated_payload_rejected():
+    payload = tensor_utils.PackedPayload()
+    span = tensor_utils.pack_tensor_span(
+        "w", np.ones(8, np.float32), payload
+    )
+    buf = b"".join(bytes(p) for p in payload.parts)
+    # Span range beyond the received bytes (a truncated chunk).
+    span.nbytes = 64
+    with pytest.raises(ValueError, match="outside"):
+        tensor_utils.unpack_tensor_span(span, buf[:16])
+    # Byte count that cannot tile the dtype.
+    span.nbytes = 30
+    with pytest.raises(ValueError, match="itemsize"):
+        tensor_utils.unpack_tensor_span(span, buf)
+    # Element count that cannot fill the declared dims.
+    span.nbytes = 16
+    with pytest.raises(ValueError, match="fill"):
+        tensor_utils.unpack_tensor_span(span, buf)
+
+
+def test_slice_parts_cover_payload_exactly():
+    payload = tensor_utils.PackedPayload()
+    payload.add_array(np.arange(10, dtype=np.float32))
+    payload.add_array(np.arange(3, dtype=np.int64))
+    whole = b"".join(bytes(p) for p in payload.parts)
+    for chunk in (1, 7, 16, 1000):
+        got = b"".join(
+            b"".join(bytes(p) for p in payload.slice_parts(s, min(s + chunk, payload.nbytes)))
+            for s in range(0, payload.nbytes, chunk)
+        )
+        assert got == whole
+
+
+# ---------------------------------------------------------------------------
+# e2e over a real PS: packed push, chunked streaming, error feedback
+# ---------------------------------------------------------------------------
+
+
+def _one_ps(lr=0.5):
+    server = ParameterServer(0, 1, optimizer_spec=optimizers.sgd(lr))
+    client = PSClient([server.addr], worker_id=0)
+    infos = [
+        pb.EmbeddingTableInfo(
+            name="e", dim=4, initializer="zeros", dtype=pb.DT_FLOAT32
+        )
+    ]
+    client.push_model({"w": np.zeros(1000, np.float32)}, infos)
+    return server, client
+
+
+def test_chunked_push_applies_once():
+    server, client = _one_ps(lr=1.0)
+    try:
+        client._max_push_bytes = 512  # 1000 f32 grads -> 8 chunks
+        grad = np.random.default_rng(1).normal(size=1000).astype(np.float32)
+        reqs = client._build_packed_requests(
+            {"w": grad}, {}, version=0, learning_rate=0.0, batch_size=4,
+        )
+        assert len(reqs[0]) == 8
+        accepted, version = client.push_gradients({"w": grad}, {}, version=0)
+        assert accepted and version == 1
+        _, _, params = client.pull_dense_parameters(["w"])
+        # sgd lr=1.0: w = 0 - grad, exactly (f32 wire is byte-exact).
+        np.testing.assert_array_equal(params["w"], -grad)
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_chunks_reassemble_out_of_order_and_dedupe():
+    server, client = _one_ps(lr=1.0)
+    try:
+        client._max_push_bytes = 1024
+        grad = np.arange(1000, dtype=np.float32)
+        reqs = client._build_packed_requests(
+            {"w": grad}, {}, version=0, learning_rate=0.0, batch_size=4,
+        )[0]
+        parsed = [
+            pb.PushGradientsPackedRequest.FromString(r.SerializeToString())
+            for r in reqs
+        ]
+        assert len(parsed) == 4
+        servicer = server.servicer
+        # Reverse order + a duplicated middle chunk (an UNAVAILABLE-retry
+        # whose first attempt landed): buffered chunks answer accepted
+        # without applying; the reassembly-completing one applies ONCE.
+        order = parsed[::-1]
+        for req in [order[0], order[1], order[1], order[2]]:
+            res = servicer.push_gradients_packed(req, None)
+            assert res.accepted and res.version == 0
+        res = servicer.push_gradients_packed(order[3], None)
+        assert res.accepted and res.version == 1
+        assert not servicer._pending_chunks
+        _, _, params = client.pull_dense_parameters(["w"])
+        np.testing.assert_array_equal(params["w"], -grad)
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_servicer_rejects_truncated_single_chunk():
+    server, client = _one_ps()
+    try:
+        req = pb.PushGradientsPackedRequest(
+            version=0, chunk_count=1, payload=b"\x00" * 16,
+            payload_total_bytes=64,
+        )
+        with pytest.raises(ValueError, match="truncated"):
+            server.servicer.push_gradients_packed(req, None)
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_int8_error_feedback_converges_on_quadratic(monkeypatch):
+    """Minimize 0.5||w - t||^2 through the int8 wire. Quantization alone
+    biases each step by up to scale/2; the client's error-feedback
+    residual carries that round-off into the next push, so the iterates
+    converge onto t anyway — and the residual equals exactly what the
+    last quantization dropped."""
+    server = ParameterServer(0, 1, optimizer_spec=optimizers.sgd(0.2))
+    client = PSClient([server.addr], worker_id=0, wire_dtype="int8")
+    try:
+        client.push_model({"w": np.zeros(512, np.float32)}, [])
+        rng = np.random.default_rng(7)
+        target = rng.normal(scale=3.0, size=512).astype(np.float32)
+        for _ in range(60):
+            _, _, params = client.pull_dense_parameters(["w"])
+            grad = params["w"] - target
+            client.push_gradients({"w": grad}, {}, version=0)
+            # The stored residual is precisely the last round-off.
+            res = client._ef_residual["w"]
+            assert np.abs(res).max() <= np.abs(grad + res).max() / 127
+        _, _, params = client.pull_dense_parameters(["w"])
+        err = np.abs(params["w"] - target).max()
+        assert err < 5e-3, err
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_bf16_and_int8_sparse_values_accumulate_exactly():
+    """Sparse embedding grads ride bf16 under both bf16 and int8 codecs;
+    id-sorted shard bucketing must not reorder or drop rows."""
+    for wire_dtype in ("bfloat16", "int8"):
+        servers = [
+            ParameterServer(i, 2, optimizer_spec=optimizers.sgd(1.0))
+            for i in range(2)
+        ]
+        client = PSClient(
+            [s.addr for s in servers], worker_id=0, wire_dtype=wire_dtype
+        )
+        try:
+            infos = [
+                pb.EmbeddingTableInfo(
+                    name="e", dim=2, initializer="zeros",
+                    dtype=pb.DT_FLOAT32,
+                )
+            ]
+            client.push_model({"w": np.zeros(4, np.float32)}, infos)
+            ids = np.array([7, 1, 7, 4], np.int64)
+            values = np.array(
+                [[1, 1], [2, 2], [3, 3], [4, 4]], np.float32
+            )
+            accepted, _ = client.push_gradients(
+                {}, {"e": (values, ids)}, version=0
+            )
+            assert accepted
+            rows = client.pull_embedding_vectors(
+                "e", np.array([1, 4, 7], np.int64)
+            )
+            # Duplicated id 7 accumulates (1+3); lr=1.0 so row = -grad.
+            np.testing.assert_array_equal(
+                rows,
+                -np.array([[2, 2], [4, 4], [4, 4]], np.float32),
+            )
+        finally:
+            client.close()
+            for s in servers:
+                s.stop()
+
+
+# ---------------------------------------------------------------------------
+# versioned embedding row cache
+# ---------------------------------------------------------------------------
+
+
+def test_row_cache_hit_miss_and_version_invalidation():
+    cache = EmbeddingRowCache(max_rows=100, staleness=2, dense_ids=1000)
+    cache.note_version(5)
+    ids = np.array([1, 4, 9], np.int64)
+    rows = np.arange(12, dtype=np.float32).reshape(3, 4)
+    hit, _ = cache.lookup("t", ids)
+    assert not hit.any()
+    cache.insert("t", ids, rows)
+    hit, got = cache.lookup("t", ids)
+    assert hit.all()
+    np.testing.assert_array_equal(got, rows)
+    # Within the staleness budget (fill 5 >= 7-2): still hits.
+    cache.note_version(7)
+    hit, _ = cache.lookup("t", ids)
+    assert hit.all()
+    # One version past the budget: every row invalidated by construction.
+    cache.note_version(8)
+    hit, got = cache.lookup("t", ids)
+    assert not hit.any() and got is None
+    # A re-pull refreshes the stamp in place and hits again.
+    cache.insert("t", ids, rows * 2)
+    hit, got = cache.lookup("t", ids)
+    assert hit.all()
+    np.testing.assert_array_equal(got, rows * 2)
+
+
+def test_row_cache_partial_hits_and_overflow_flush():
+    cache = EmbeddingRowCache(max_rows=4, staleness=-1, dense_ids=1000)
+    cache.insert("t", np.array([1, 2], np.int64),
+                 np.ones((2, 3), np.float32))
+    hit, got = cache.lookup("t", np.array([1, 5, 2], np.int64))
+    np.testing.assert_array_equal(hit, [True, False, True])
+    assert got.shape == (2, 3)
+    # Exceeding max_rows flushes the table and refills with the insert.
+    cache.insert("t", np.array([3, 4, 5], np.int64),
+                 np.full((3, 3), 2.0, np.float32))
+    hit, _ = cache.lookup("t", np.array([1, 2], np.int64))
+    assert not hit.any()
+    hit, _ = cache.lookup("t", np.array([3, 4, 5], np.int64))
+    assert hit.all()
+
+
+def test_row_cache_dense_id_cap_disables_table():
+    cache = EmbeddingRowCache(max_rows=100, staleness=-1, dense_ids=64)
+    cache.insert("big", np.array([999], np.int64),
+                 np.ones((1, 2), np.float32))
+    hit, _ = cache.lookup("big", np.array([999], np.int64))
+    assert not hit.any()
+    # Once disabled, even small-id inserts stay out.
+    cache.insert("big", np.array([1], np.int64),
+                 np.ones((1, 2), np.float32))
+    hit, _ = cache.lookup("big", np.array([1], np.int64))
+    assert not hit.any()
+
+
+def test_row_cache_negative_ids_never_hit_or_corrupt():
+    """Negative ids cannot be represented by the dense index: a lookup
+    must miss them (no fancy-indexing wraparound serving another id's
+    row) and an insert containing one disables the table instead of
+    corrupting other ids' slots."""
+    cache = EmbeddingRowCache(max_rows=100, staleness=-1, dense_ids=64)
+    ids = np.array([1, 5], np.int64)
+    rows = np.arange(6, dtype=np.float32).reshape(2, 3)
+    cache.insert("t", ids, rows)
+    # -59 would wrap onto slot index 5 without the sign check.
+    hit, got = cache.lookup("t", np.array([-59, -1, 5], np.int64))
+    np.testing.assert_array_equal(hit, [False, False, True])
+    np.testing.assert_array_equal(got, rows[1:])
+    # Ids far below -len(idx) must not raise either.
+    hit, _ = cache.lookup("t", np.array([-10**9], np.int64))
+    assert not hit.any()
+    cache.insert("neg", np.array([-3], np.int64),
+                 np.ones((1, 3), np.float32))
+    hit, _ = cache.lookup("neg", np.array([-3], np.int64))
+    assert not hit.any()  # table disabled, nothing cached
+
+
+def test_prefetch_overlap_trainer_uses_cache_and_exports_hit_rate():
+    """Trainer-level: with prefetch overlap on, repeated batches serve
+    embedding rows from the cache (hits export as edl_ metrics), and a
+    PS version bump past the staleness budget invalidates — the next
+    prefetch pulls fresh rows."""
+    from elasticdl_tpu.observability.metrics import default_registry
+    from elasticdl_tpu.worker.ps_trainer import ParameterServerTrainer
+
+    em = embedding_test_module
+    server = ParameterServer(0, 1, optimizer_spec=em.optimizer())
+    trainer = None
+    client = PSClient([server.addr], worker_id=0)
+    try:
+        trainer = ParameterServerTrainer(
+            em.custom_model(),
+            em.loss,
+            em.optimizer(),
+            client,
+            embedding_inputs=em.embedding_inputs,
+            pipeline_pushes=True,
+            prefetch_overlap=True,
+        )
+        rng = np.random.default_rng(0)
+        features = {
+            "ids": rng.integers(0, em.VOCAB, size=(8, 2)),
+            "x": rng.normal(size=(8, em.DENSE_DIM)).astype(np.float32),
+        }
+        labels = rng.normal(size=(8,)).astype(np.float32)
+        assert trainer._row_cache is not None
+        for _ in range(3):
+            trainer.train_minibatch(features, labels,
+                                    next_features=features)
+        trainer._flush_pushes()
+        stats = trainer._row_cache.stats()
+        assert stats["hits"] > 0, stats
+        assert stats["hit_ratio"] > 0
+        exposed = default_registry().expose()
+        assert "edl_prefetch_row_cache_hits_total" in exposed
+        assert "edl_prefetch_row_cache_hit_ratio" in exposed
+        # The PS clock jumping past the staleness budget invalidates.
+        unique = np.unique(features["ids"].reshape(-1)).astype(np.int64)
+        hit, _ = trainer._row_cache.lookup("item_emb", unique)
+        assert hit.all()
+        trainer._row_cache.note_version(
+            stats["version"]
+            + max(trainer._row_cache._staleness, 0) + 1
+        )
+        hit, _ = trainer._row_cache.lookup("item_emb", unique)
+        assert not hit.any()
+    finally:
+        if trainer is not None:
+            trainer._flush_pushes()
+        client.close()
+        server.stop()

@@ -12,6 +12,7 @@ from tools.edl_lint.rules.mesh_spec import MeshSpecRule
 from tools.edl_lint.rules.metric_names import MetricNamesRule
 from tools.edl_lint.rules.proto_drift import ProtoDriftRule
 from tools.edl_lint.rules.rpc_deadlines import RpcDeadlinesRule
+from tools.edl_lint.rules.wire_codec import WireCodecRule
 
 ALL_RULES = (
     ConcurrencyRule,
@@ -22,6 +23,7 @@ ALL_RULES = (
     HotPathSyncRule,
     MeshSpecRule,
     EnvKnobsRule,
+    WireCodecRule,
     ProtoDriftRule,
     RpcDeadlinesRule,
     MetricNamesRule,
