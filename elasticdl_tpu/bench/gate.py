@@ -100,6 +100,18 @@ def run_gate(baseline_path=None, candidate_path=None, min_effect=None,
         print("bench-gate: FAIL (significant regression)", file=out)
         print(json.dumps(verdict), file=out)
         return 1
+    if overall == stats.VERDICT_SUSPECT:
+        # Isolated flags in a wide metric family: below the coherence
+        # bar real (shared-code-path) regressions clear, and within the
+        # per-cell between-run false-positive rate. Loud, not fatal —
+        # re-measure the named cells with more repeats to confirm.
+        print(
+            "bench-gate: PASS (suspect — isolated cell flags, below "
+            f"the coherence bar: {', '.join(verdict.get('suspect', []))}"
+            "; re-measure those cells before trusting a trend)",
+            file=out,
+        )
+        return 0
     print(f"bench-gate: PASS ({overall})", file=out)
     return 0
 

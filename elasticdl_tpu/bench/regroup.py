@@ -1,0 +1,146 @@
+"""In-process elastic-regroup microbench: cold vs warm (speculative AOT).
+
+`python -m elasticdl_tpu.bench.regroup` — run by the rejoin benchmark
+in a SUBPROCESS with a virtual 8-device CPU platform, so the main bench
+process's backend (and its single-device view) is untouched.
+
+What it measures (the tentpole claim of the recompile-free-elasticity
+work): the wall time for a LIVE trainer to absorb a world change and
+complete its first step in the new world —
+
+  regroup_cold_s   the world reshapes (8 -> 7 devices) with speculation
+                   off and a cold compilation cache: the regroup pays a
+                   full re-lower + XLA compile, the pre-PR price of
+                   every elastic epoch;
+  regroup_warm_s   the world reshapes back (7 -> 8) after the
+                   speculator prebuilt that world's step in the
+                   background: the regroup installs the executable and
+                   steps immediately.
+
+The membership epoch is driven through a real in-process master
+(membership service), and the device-count change stands in for the
+process-count change of a production multi-host regroup — the world
+spec resolution is identical (parallel/mesh.py), only the topology
+source differs. Same-spec epoch bumps (the single-host common case) are
+not measured here because they cost ~nothing by construction — the
+worker-kill drill asserts that path's counters instead.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def _ensure_test_paths():
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    for sub in ("tests", "tools"):
+        p = os.path.join(repo, sub)
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+
+
+def run_regroup_bench(batch=16):
+    _ensure_test_paths()
+    # Speculation off for the cold cell; flipped on (live knob read) for
+    # the warm cell below.
+    os.environ["ELASTICDL_AOT_SPECULATE"] = "0"
+    import jax
+    import numpy as np
+
+    from test_utils import start_master
+
+    from elasticdl_tpu.models.transformer import transformer_lm as tlm
+    from elasticdl_tpu.parallel.mesh import WorldTopology
+    from elasticdl_tpu.worker.allreduce_trainer import AllReduceTrainer
+    from elasticdl_tpu.worker.master_client import MasterClient
+
+    n_dev = len(jax.devices())
+    # A small-but-real transformer, not the linear toy: the cold cell
+    # must contain a representative re-lower + XLA compile, which for a
+    # few-layer attention stack is O(seconds) on a CPU host — the same
+    # order the compile tracker measured for elastic regroups in r06.
+    cfg = tlm.LMConfig(
+        vocab=256, d_model=64, n_heads=4, n_layers=2, max_len=64,
+        activation_dtype="float32",
+    )
+    tokens = (
+        np.arange(batch * (cfg.max_len + 1)).reshape(
+            batch, cfg.max_len + 1
+        )
+        * 7
+    ) % cfg.vocab
+    x, y = tokens[:, :-1], tokens[:, 1:]
+
+    out = {"n_devices": n_dev, "batch": batch}
+    fake_host = 2
+
+    def bump_membership(m):
+        nonlocal fake_host
+        m["membership"].add_worker_host(f"10.0.0.{fake_host}:9999")
+        fake_host += 1
+
+    with start_master(
+        training_shards={"f": (0, 100)}, with_membership=True
+    ) as m:
+        mc = MasterClient(
+            m["addr"], worker_id=0, worker_host="127.0.0.1"
+        )
+        t = AllReduceTrainer(
+            tlm.custom_model(cfg),
+            tlm.loss,
+            tlm.optimizer(),
+            mc,
+            steps_per_world_check=1,
+        )
+        try:
+            # Settle in the full-device world (first compile excluded —
+            # it is cold-start, not regroup).
+            for _ in range(2):
+                jax.block_until_ready(t.train_minibatch(x, y)[2])
+
+            # COLD: the world reshapes to n-1 devices; the regroup
+            # re-lowers and XLA-compiles synchronously.
+            t._topo_override = WorldTopology(n_dev - 1, n_dev - 1, 1)
+            bump_membership(m)
+            t0 = time.perf_counter()
+            jax.block_until_ready(t.train_minibatch(x, y)[2])
+            out["regroup_cold_s"] = round(time.perf_counter() - t0, 4)
+
+            # WARM: speculate the full-device world from inside the
+            # shrunk one, then regroup back into the guess.
+            os.environ["ELASTICDL_AOT_SPECULATE"] = "1"
+            t._topo_candidates = [WorldTopology(n_dev, n_dev, 1)]
+            jax.block_until_ready(t.train_minibatch(x, y)[2])
+            if not t._speculator.drain(120):
+                out["error"] = "speculator never drained"
+                return out
+            t._topo_override = WorldTopology(n_dev, n_dev, 1)
+            bump_membership(m)
+            t0 = time.perf_counter()
+            jax.block_until_ready(t.train_minibatch(x, y)[2])
+            out["regroup_warm_s"] = round(time.perf_counter() - t0, 4)
+            out["speculative_consumed"] = t._speculator.stats[
+                "consumed"
+            ]
+        finally:
+            t.close()
+            mc.close()
+    return out
+
+
+def main():
+    try:
+        result = run_regroup_bench()
+    except Exception as e:  # the parent bench records the error cell
+        result = {"error": str(e)[:300]}
+    print("REGROUP_RESULT " + json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,6 +43,33 @@ VERDICT_IMPROVEMENT = "improvement"
 VERDICT_NOISE = "noise"
 VERDICT_INSUFFICIENT = "insufficient-data"
 VERDICT_INCOMPARABLE = "incomparable"
+# Overall-only verdict: isolated per-metric regression flags inside a
+# WIDE metric family, demoted by the multiple-comparisons rule in
+# compare_records (the flags are preserved per-metric and listed under
+# "suspect" — visible, re-measurable, but not a gate failure).
+VERDICT_SUSPECT = "suspect"
+
+# Multiple-comparisons control for the overall verdict. The per-metric
+# test bootstraps WITHIN-run samples only, so it cannot see between-run
+# variance (host day-drift, scheduler luck on a 1-core box): measured
+# same-code A/B on this host shows individual 3-repeat PS cells swinging
+# +-9% run to run, which at min_effect=2% makes each of the ~19 compared
+# metrics a ~5-10% false-positive lottery ticket — a SAME-CODE rerun of
+# r07 flags 1-2 random cells nearly every time. Real code regressions
+# are coherent instead: the cells share one transport/trainer path, so a
+# genuine slowdown moves many of them at once (the r06->r07 improvement
+# moved 13/13 shared metrics; a contaminated run moved 5). Hence: when a
+# comparison spans at least WIDE_FAMILY_MIN metrics, fewer than
+# COHERENT_REGRESSIONS flags demote to "suspect"; narrow comparisons
+# (a handful of headline metrics, each its own claim) keep strict
+# worst-across-metrics semantics.
+WIDE_FAMILY_MIN = 8
+COHERENT_REGRESSIONS = 3
+# Magnitude escape hatch: the demotion exists for the measured ±9%
+# between-run cell lottery, so a flag FAR outside that band (a genuine
+# subsystem collapse confined to one or two cells — e.g. a workload
+# only one cell measures) is never demoted, however isolated.
+SEVERE_REGRESSION_EFFECT = 0.25
 
 
 def bootstrap_ci(samples, n_boot=DEFAULT_BOOTSTRAP_N, alpha=DEFAULT_ALPHA,
@@ -339,5 +366,30 @@ def compare_records(baseline, candidate, min_effect=DEFAULT_MIN_EFFECT,
         out["metrics"][name] = verdict
         if rank[verdict["verdict"]] > rank[worst]:
             worst = verdict["verdict"]
-    out["overall"] = worst if out["metrics"] else VERDICT_INSUFFICIENT
+    if not out["metrics"]:
+        out["overall"] = VERDICT_INSUFFICIENT
+        return out
+    regressed = sorted(
+        name
+        for name, v in out["metrics"].items()
+        if v["verdict"] == VERDICT_REGRESSION
+    )
+    severe = any(
+        abs(out["metrics"][m].get("effect", 0.0))
+        >= SEVERE_REGRESSION_EFFECT
+        for m in regressed
+    )
+    if (
+        worst == VERDICT_REGRESSION
+        and not severe
+        and len(out["metrics"]) >= WIDE_FAMILY_MIN
+        and len(regressed) < COHERENT_REGRESSIONS
+    ):
+        # Isolated flags in a wide family: statistically indistinguishable
+        # from the per-metric test's between-run false-positive rate (see
+        # the constants above). Kept visible for follow-up, not a failure.
+        out["overall"] = VERDICT_SUSPECT
+        out["suspect"] = regressed
+    else:
+        out["overall"] = worst
     return out

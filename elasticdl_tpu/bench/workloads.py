@@ -17,6 +17,7 @@ the jitted train step runs with donated buffers (synthetic-data-
 resident mode) — measuring the training step, not host dataloading.
 """
 
+import json
 import os
 import time
 
@@ -319,7 +320,22 @@ def bench_elastic_rejoin():
     loses a worker to SIGKILL to have its replacement back in the job
     (detection + task recovery + relaunch + re-init + first RPC).
     Runs the real CLI cluster on the CPU platform so it never contends
-    with the TPU benchmarks; rejoin time is control-plane latency."""
+    with the TPU benchmarks; rejoin time is control-plane latency.
+
+    Cells (the recompile-free-elasticity additions):
+      rejoin_s              cold relaunch, best-of-2, no compile cache —
+                            comparable with every earlier round;
+      rejoin_warm_cache_s   one more drill with ELASTICDL_COMPILE_CACHE_DIR
+                            armed: the replacement worker rehydrates its
+                            step from the disk entries its first
+                            incarnation wrote, so the rejoin no longer
+                            contains an XLA compile;
+      regroup_cold_s /      in-process world-RESHAPE latency (see
+      regroup_warm_s        bench/regroup.py): what a SURVIVOR pays to
+                            step in a changed world, with and without a
+                            speculatively prebuilt executable.
+    """
+    import subprocess
     import sys
     import tempfile
 
@@ -334,6 +350,7 @@ def bench_elastic_rejoin():
 
         from elasticdl_tpu.data.recordfile import RecordFileWriter
 
+        out = {}
         with tempfile.TemporaryDirectory() as d:
             data = os.path.join(d, "linear.edlr")
             with RecordFileWriter(data) as w:
@@ -350,19 +367,91 @@ def bench_elastic_rejoin():
                     num_workers=2,
                     num_ps=1,
                     num_epochs=300,
-                    env_overrides={"JAX_PLATFORMS": "cpu"},
+                    # Cold must be COLD even when the operator exports
+                    # the cache knob globally (empty string = disabled):
+                    # rejoin_s is the historical cold series.
+                    env_overrides={
+                        "JAX_PLATFORMS": "cpu",
+                        "ELASTICDL_COMPILE_CACHE_DIR": "",
+                    },
                     timeout=600,
                 )
                 for _ in range(2)
             ]
-        ok = [r for r in results if r.get("rejoin_s") is not None]
-        best = min(ok, key=lambda r: r["rejoin_s"]) if ok else results[0]
-        return {
-            "rejoin_s": best.get("rejoin_s"),
-            "rejoin_s_runs": [r.get("rejoin_s") for r in results],
-            "best_of_n": 2,
-            "completed": best.get("completed"),
-            "relaunched": best.get("relaunched"),
-        }
+            ok = [r for r in results if r.get("rejoin_s") is not None]
+            best = (
+                min(ok, key=lambda r: r["rejoin_s"]) if ok else results[0]
+            )
+            out.update(
+                {
+                    "rejoin_s": best.get("rejoin_s"),
+                    "rejoin_s_runs": [
+                        r.get("rejoin_s") for r in results
+                    ],
+                    "best_of_n": 2,
+                    "completed": best.get("completed"),
+                    "relaunched": best.get("relaunched"),
+                }
+            )
+            # Warm-cache drill: the job's own pre-kill compiles populate
+            # the cache; the SIGKILLed worker's replacement rehydrates.
+            warm = run_drill(
+                data,
+                model_zoo=os.path.join(repo, "tests"),
+                model_def="test_module",
+                num_workers=2,
+                num_ps=1,
+                num_epochs=300,
+                env_overrides={
+                    "JAX_PLATFORMS": "cpu",
+                    "ELASTICDL_COMPILE_CACHE_DIR": os.path.join(
+                        d, "compile_cache"
+                    ),
+                },
+                timeout=600,
+            )
+            out["rejoin_warm_cache_s"] = warm.get("rejoin_s")
+            out["rejoin_warm_completed"] = warm.get("completed")
+        # In-process regroup cells, in their own virtual-8-device
+        # subprocess so this process's backend stays untouched.
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        # Cold must be COLD: no persistent cache for the subprocess.
+        env.pop("ELASTICDL_COMPILE_CACHE_DIR", None)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "elasticdl_tpu.bench.regroup"],
+                capture_output=True,
+                text=True,
+                env=env,
+                cwd=repo,
+                timeout=300,
+            )
+            line = next(
+                (
+                    ln
+                    for ln in proc.stdout.splitlines()
+                    if ln.startswith("REGROUP_RESULT ")
+                ),
+                None,
+            )
+            if line:
+                regroup = json.loads(line[len("REGROUP_RESULT "):])
+                for key in (
+                    "regroup_cold_s",
+                    "regroup_warm_s",
+                    "speculative_consumed",
+                    "error",
+                ):
+                    if key in regroup:
+                        out[key] = regroup[key]
+            else:
+                out["regroup_error"] = (proc.stderr or "no output")[
+                    -200:
+                ]
+        except Exception as e:
+            out["regroup_error"] = str(e)[:200]
+        return out
     except Exception as e:  # never let the drill sink the whole bench
         return {"rejoin_s": None, "error": str(e)[:200]}

@@ -1,0 +1,90 @@
+"""Persistent XLA compilation cache wiring (recompile-free elasticity).
+
+The compile tracker proved that compile IS the elastic rejoin: every
+worker relaunch — the common preemption case — cold-compiled a step this
+host had already compiled, minutes of accumulated dead time at
+production pod-churn rates. jax ships a content-addressed persistent
+compilation cache (HLO-keyed executables on disk); this module is the
+one place the framework turns it on, from the registered
+`ELASTICDL_COMPILE_CACHE_DIR` knob, so that:
+
+- a RELAUNCHED worker rehydrates its step executables from disk and
+  pays only trace+lower on its first minibatch (the `compile_cache_hit`
+  event in observability/profiling.py, not a cold `compile`);
+- a multi-host regroup that re-initializes jax.distributed (tearing
+  down every live executable) re-lowers into warm disk entries;
+- SPECULATIVE world compiles (worker/world_speculator.py) persist: even
+  when the guessed executable object dies with a backend re-init, its
+  disk entry survives for the re-lowering on the other side.
+
+Both instance managers stamp the knob into every child's environment,
+so one `edl train` invocation warms a single cache for the whole job
+(all ranks lower the same SPMD program — one rank's miss is every
+later rank's hit).
+
+Thresholds are zeroed (`min_compile_time_secs`, `min_entry_size`):
+elasticity cares about the many small programs around the step (eval
+forwards, broadcast zero-templates), not only the headline compile.
+"""
+
+import os
+import threading
+
+from elasticdl_tpu.common import knobs
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger("common.compile_cache")
+
+CACHE_DIR_ENV = "ELASTICDL_COMPILE_CACHE_DIR"
+
+_lock = threading.Lock()
+_configured = None  # dir string once wired, "" once checked-and-disabled
+
+
+def ensure_compile_cache():
+    """Idempotently point jax at the persistent compilation cache
+    directory named by ELASTICDL_COMPILE_CACHE_DIR. Returns the dir, or
+    None when the knob is unset (or jax lacks the config surface). Safe
+    to call from every trainer/bench/role entrypoint — the first caller
+    wins, later calls are a lock + string compare."""
+    global _configured
+    with _lock:
+        if _configured is not None:
+            return _configured or None
+        cache_dir = knobs.get_str(CACHE_DIR_ENV)
+        if not cache_dir:
+            _configured = ""
+            return None
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            # Cache EVERYTHING: the defaults skip sub-second compiles
+            # and small executables, which is exactly the long tail a
+            # relaunched worker re-pays (eval forward, state templates).
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0
+            )
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", -1
+            )
+        except Exception:
+            logger.warning(
+                "Could not enable the persistent compilation cache at "
+                "%s; compiles will not survive relaunches",
+                cache_dir,
+                exc_info=True,
+            )
+            _configured = ""
+            return None
+        _configured = cache_dir
+        logger.info("Persistent compilation cache at %s", cache_dir)
+        return cache_dir
+
+
+def reset_for_tests():
+    """Drop the memoized wiring so a test can re-point the cache."""
+    global _configured
+    with _lock:
+        _configured = None

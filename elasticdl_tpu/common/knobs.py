@@ -272,6 +272,25 @@ declare("ELASTICDL_PREFETCH_CACHE_STALENESS", "int", 8,
         "— the bounded-staleness contract async SGD already absorbs. "
         "Negative disables the version check (never invalidate).")
 
+# -- recompile-free elasticity (common/compile_cache.py, worker/) --
+declare("ELASTICDL_COMPILE_CACHE_DIR", "str", "",
+        "Directory for jax's persistent compilation cache: step "
+        "executables are rehydrated from disk across process relaunches "
+        "(the common preemption case), so a relaunched worker's first "
+        "step pays trace+lower instead of a full XLA compile. Stamped "
+        "into child env by both instance managers; empty disables.")
+declare("ELASTICDL_AOT_SPECULATE", "str", "auto",
+        "Speculative ahead-of-time world compilation: a background "
+        "thread compiles the step of candidate nearby worlds (keyed by "
+        "the unified world spec) while training continues, so an "
+        "elastic regroup consumes a prebuilt executable instead of "
+        "cold-compiling. 0/false/off disables.")
+declare("ELASTICDL_AOT_WORLDS", "int", 1,
+        "How many neighboring world sizes the speculator guesses in "
+        "each direction (N±delta). Only worlds whose mesh is buildable "
+        "on the live backend compile directly; the rest are skipped "
+        "(their relaunch path is covered by the persistent cache).")
+
 # -- worker resilience (worker/) --
 declare("ELASTICDL_PS_DEGRADED_BLOCK_SECONDS", "float", 20.0,
         "Budget for _sync_model's re-seed/backoff loop on a degraded PS "

@@ -230,8 +230,15 @@ class Master:
                     observability.OBS_DIR_ENV
                 )
             # Log identity/format follows the master into the pods so a
-            # chaos run's JSON logs correlate across roles.
-            for var in ("ELASTICDL_LOG_LEVEL", "ELASTICDL_LOG_FORMAT"):
+            # chaos run's JSON logs correlate across roles; the compile
+            # cache dir follows so every pod of the job shares ONE
+            # persistent cache (a relaunched pod rehydrates executables
+            # its predecessor or peers already compiled).
+            for var in (
+                "ELASTICDL_LOG_LEVEL",
+                "ELASTICDL_LOG_FORMAT",
+                "ELASTICDL_COMPILE_CACHE_DIR",
+            ):
                 if knobs.is_set(var):
                     envs[var] = knobs.raw(var)
             return K8sInstanceManager(

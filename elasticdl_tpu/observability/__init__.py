@@ -136,6 +136,20 @@ def setup(role, job="", obs_dir=None, metrics_port=None, registry=None):
     if metrics_port is None:
         metrics_port = knobs.get_int(METRICS_PORT_ENV)
     log_utils.set_identity(job=job, role=role)
+    # Instrumented roles that already pulled in jax get the persistent
+    # compilation cache wired here (recompile-free elasticity). Gated on
+    # jax being imported so a jax-free control plane (the master) never
+    # pays a multi-hundred-MB jax import for a cache it cannot use —
+    # compiling roles that set up BEFORE importing jax (worker, PS) wire
+    # it at their trainer/server construction instead.
+    import sys as _sys
+
+    if "jax" in _sys.modules:
+        from elasticdl_tpu.common.compile_cache import (
+            ensure_compile_cache,
+        )
+
+        ensure_compile_cache()
 
     recorder = None
     event_log = None

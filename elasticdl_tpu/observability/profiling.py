@@ -86,6 +86,13 @@ _G_LAST_COMPILE = _REG.gauge(
     "edl_compile_last_seconds",
     "Duration of the most recent tracked compile",
 )
+_C_CACHE_HITS = _REG.counter(
+    "edl_compile_cache_hits_total",
+    "Tracked lowerings fully served by the persistent compilation "
+    "cache (rehydrated executables, by function and the cause the "
+    "compile would have had)",
+    labelnames=("fn", "cause"),
+)
 
 # jax.monitoring event keys that cover a lowering's host-side cost on
 # this runtime (trace -> MLIR -> backend compile).
@@ -93,6 +100,15 @@ _COMPILE_EVENT_PREFIXES = (
     "/jax/core/compile/",
     "/jax/pjit/",
 )
+
+# Persistent-compilation-cache outcome events (common/compile_cache.py
+# wires the cache): a lowering whose every backend compile was served
+# from disk is a REHYDRATION, not a compile — it lands as a
+# `compile_cache_hit` event + edl_compile_cache_hits_total, and does NOT
+# count toward edl_compile_total (so "mesh_change stays flat during a
+# warm-cache worker-kill drill" is assertable directly on the counter).
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
 
 
 def tracker_enabled():
@@ -141,8 +157,16 @@ def _on_event_duration(name, secs, **kw):
         sink.append((name, float(secs)))
 
 
+def _on_event(name, **kw):
+    sink = getattr(_capture, "events", None)
+    if sink is None:
+        return
+    if name in (_CACHE_HIT_EVENT, _CACHE_MISS_EVENT):
+        sink.append(name)
+
+
 def _install_listener():
-    """Register the process-wide jax.monitoring listener once (lazily,
+    """Register the process-wide jax.monitoring listeners once (lazily,
     so importing this module never imports jax)."""
     global _listener_installed
     with _listener_lock:
@@ -154,26 +178,40 @@ def _install_listener():
             jax.monitoring.register_event_duration_secs_listener(
                 _on_event_duration
             )
+            jax.monitoring.register_event_listener(_on_event)
             _listener_installed = True
         except Exception:  # unexpected runtime without monitoring
             _listener_installed = True  # don't retry every call
 
 
 class _MonitoringCapture:
-    """Collects this thread's compile-phase durations around one call."""
+    """Collects this thread's compile-phase durations (and persistent-
+    cache outcome events) around one call."""
 
     def __enter__(self):
         self._prev = getattr(_capture, "sink", None)
+        self._prev_events = getattr(_capture, "events", None)
         self.samples = []
+        self.cache_events = []
         _capture.sink = self.samples
+        _capture.events = self.cache_events
         return self
 
     def __exit__(self, *exc):
         _capture.sink = self._prev
+        _capture.events = self._prev_events
         return False
 
     def compile_seconds(self):
         return sum(secs for _, secs in self.samples)
+
+    def persistent_cache_hit(self):
+        """True when the persistent compilation cache served EVERY
+        backend compile of this call (one jit call can compile several
+        subprograms; a single miss means real compile work happened)."""
+        hits = self.cache_events.count(_CACHE_HIT_EVENT)
+        misses = self.cache_events.count(_CACHE_MISS_EVENT)
+        return hits > 0 and misses == 0
 
 
 # ---------------------------------------------------------------------------
@@ -219,10 +257,16 @@ class CompileTracker:
         return hist, CAUSE_SHAPE
 
     def record(self, name, cause, seconds, wall_seconds, sig=None,
-               mesh_token=""):
+               mesh_token="", cache_hit=False):
         """One observed compile: metrics + event + recent-report entry.
         The trace span is recorded by the caller (it owns the start
-        timestamp)."""
+        timestamp). `cache_hit=True` means the persistent compilation
+        cache rehydrated the executable: the lowering updates the
+        classification history (later re-lowerings of the same signature
+        still read as rebuilds) but lands as a `compile_cache_hit` event
+        and counter instead of a compile — it neither moves
+        edl_compile_total nor widens the peak-compile floor timeouts
+        derive from."""
         with self._lock:
             hist = self._history.get(name)
             if hist is None:
@@ -231,23 +275,35 @@ class CompileTracker:
             hist.last_mesh_token = mesh_token
             if sig is not None:
                 hist.sigs.add((mesh_token, sig))
-            self.total_compiles += 1
-            self.total_seconds += seconds
-            self.peak_seconds = max(self.peak_seconds, seconds)
-            self.by_cause[cause] = self.by_cause.get(cause, 0) + 1
-            self._events.append(
-                {
-                    "ts": time.time(),
-                    "fn": name,
-                    "cause": cause,
-                    "seconds": round(seconds, 4),
-                }
-            )
+            entry = {
+                "ts": time.time(),
+                "fn": name,
+                "cause": cause,
+                "seconds": round(seconds, 4),
+            }
+            if cache_hit:
+                entry["cache_hit"] = True
+            else:
+                self.total_compiles += 1
+                self.total_seconds += seconds
+                self.peak_seconds = max(self.peak_seconds, seconds)
+                self.by_cause[cause] = self.by_cause.get(cause, 0) + 1
+            self._events.append(entry)
             del self._events[: -self._events_cap]
+        world = current_mesh()[1]
+        if cache_hit:
+            _C_CACHE_HITS.labels(fn=name, cause=cause).inc()
+            _events.emit(
+                "compile_cache_hit",
+                fn=name,
+                cause=cause,
+                seconds=round(seconds, 4),
+                world_size=world,
+            )
+            return
         _C_COMPILES.labels(fn=name, cause=cause).inc()
         _C_COMPILE_SECONDS.labels(fn=name, cause=cause).inc(seconds)
         _G_LAST_COMPILE.set(seconds)
-        world = current_mesh()[1]
         _events.emit(
             "compile",
             fn=name,
@@ -382,17 +438,22 @@ class TrackedFunction:
                 return out
             self._expected_cache = size
         compile_s = cap.compile_seconds() or wall
+        cache_hit = cap.persistent_cache_hit()
         with _tracker._lock:
             _, cause = _tracker.classify_locked(
                 self._name, sig, mesh_token
             )
         _tracker.record(
             self._name, cause, compile_s, wall, sig=sig,
-            mesh_token=mesh_token,
+            mesh_token=mesh_token, cache_hit=cache_hit,
         )
         tracing.record_span(
             f"compile:{self._name}", start, wall, cat="compile",
-            args={"cause": cause, "compile_s": round(compile_s, 4)},
+            args={
+                "cause": cause,
+                "compile_s": round(compile_s, 4),
+                **({"persistent_cache": "hit"} if cache_hit else {}),
+            },
         )
         if compile_s > 0.5:
             logger.info(

@@ -139,6 +139,14 @@ def ensure_world(coordinator_addr, world_size, rank, epoch=None):
     )
 
 
+def is_live():
+    """True while this process is a member of a live multi-host world —
+    i.e. a world change would re-initialize jax.distributed and tear
+    down every compiled executable (the regroup fast path keys on the
+    negation)."""
+    return _current["live"]
+
+
 def leave_world():
     if _current["live"]:
         _shutdown_quietly()

@@ -1,4 +1,6 @@
-"""Device mesh construction and batch sharding helpers.
+"""Device mesh construction, batch sharding helpers, and the WORLD SPEC —
+the single deterministic map from (parallel config, world topology) to the
+mesh an elastic trainer builds.
 
 The reference's allreduce path gets its topology from Horovod's Gloo ring
 (/root/reference/elasticdl/python/worker/allreduce_trainer.py:77-83). The
@@ -6,9 +8,25 @@ TPU-native equivalent is a named `jax.sharding.Mesh`: data parallelism is the
 "data" axis, tensor/model parallelism "model", sequence/context parallelism
 "seq". XLA lowers psum/all_gather over the mesh to ICI collectives on real
 hardware; nothing here is CPU/TPU specific.
+
+World spec (`resolve_world_spec`): every parallel feature — ZeRO-1
+(parallel/zero1.py), tensor parallelism (tensor_parallel.py), pipelining
+(pipeline*.py), sequence parallelism (ring_attention.py / ulysses.py) —
+contributes an `AxisDemand` naming the mesh axis it needs; the resolver
+composes them under one precedence policy (stage excludes model/seq; seq
+drops before model; zero only factors pure DP) into a `WorldSpec`. The
+spec is a pure function of `(ParallelConfig, WorldTopology)`: given the
+same config, an N-device world always maps to the same axes — which is
+what lets a trainer compile the step of a world it is NOT in yet
+(speculative AOT, worker/world_speculator.py) and recognize a membership
+epoch bump that does not change the mesh at all (the recompile-free
+regroup fast path). Mesh construction anywhere else in the tree is
+rejected by the `mesh-spec-consistency` lint rule: the spec API here is
+the only place a Mesh may be born.
 """
 
 import math
+from typing import Callable, NamedTuple, Optional
 
 import jax
 import numpy as np
@@ -151,6 +169,295 @@ def pad_batch_to_multiple(batch, multiple):
         lambda x: np.take(x, idx, axis=0), batch
     )
     return padded, real_n
+
+
+# ---------------------------------------------------------------------------
+# World spec: deterministic (config, topology) -> mesh resolution
+# ---------------------------------------------------------------------------
+
+
+class WorldTopology(NamedTuple):
+    """The device shape of one world: everything mesh resolution may
+    depend on. A speculating trainer builds topologies for worlds it is
+    not in yet (e.g. the N-1-process world after a preemption)."""
+
+    n_devices: int
+    local_devices: int
+    n_processes: int
+
+    @staticmethod
+    def current():
+        return WorldTopology(
+            n_devices=len(jax.devices()),
+            local_devices=jax.local_device_count(),
+            n_processes=jax.process_count(),
+        )
+
+    @property
+    def multi_process(self):
+        return self.n_processes > 1
+
+
+class AxisDemand(NamedTuple):
+    """One parallel feature's request for a mesh axis. Feature modules
+    (zero1 / tensor_parallel / pipeline / ring_attention) construct
+    these; the resolver composes them. `intra_process` demands must lie
+    entirely inside one process's device slice in multi-process worlds —
+    the composition invariant that keeps (variables, opt_state) fully
+    addressable on every host for elastic regroup snapshots."""
+
+    axis: str
+    size: int
+    intra_process: bool = True
+
+    def infeasible_reason(self, topo: WorldTopology, trailing: int = 1):
+        """Why this demand cannot be laid out on `topo` (None = it can).
+        `trailing` is the product of other already-granted trailing-axis
+        sizes it must co-divide with (e.g. model x seq)."""
+        want = self.size * trailing
+        if topo.n_devices % want:
+            return (
+                f"{self.axis} axis of {self.size} (x{trailing} trailing) "
+                f"does not divide {topo.n_devices} devices"
+            )
+        if (
+            self.intra_process
+            and topo.multi_process
+            and topo.local_devices % want
+        ):
+            return (
+                f"{self.axis} axis of {self.size} (x{trailing} trailing) "
+                f"does not divide the {topo.local_devices} local devices "
+                f"of each process (intra-process axis)"
+            )
+        return None
+
+
+class ParallelConfig(NamedTuple):
+    """The trainer-config slice world resolution consumes. Hook PRESENCE
+    is a bool (the hooks themselves stay on the trainer); `sp_suspended`
+    carries the per-world ulysses/ring downgrade bit."""
+
+    model_parallel: int = 1
+    has_param_specs: bool = False
+    zero1: bool = False
+    pipeline_stages: int = 1
+    has_pipeline_spec: bool = False
+    context_parallel: int = 1
+    has_context_parallel_model: bool = False
+    sp_suspended: bool = False
+
+
+class WorldSpec:
+    """A resolved world: ordered mesh axes + which features are active.
+
+    Hashable by `fingerprint()` — the identity the compile tracker, the
+    speculative AOT store, and the regroup fast path all key on: two
+    worlds with the same fingerprint compile byte-identical step
+    programs, so a membership epoch bump that resolves to the same
+    fingerprint needs NO re-lowering."""
+
+    __slots__ = (
+        "axes",
+        "process_grouped",
+        "topology",
+        "tp",
+        "sp",
+        "pp",
+        "zero1",
+        "notes",
+    )
+
+    def __init__(self, axes, process_grouped, topology, tp=1, sp=1, pp=1,
+                 zero1=False, notes=()):
+        self.axes = tuple(axes)  # ((name, size), ...) ordered
+        self.process_grouped = bool(process_grouped)
+        self.topology = topology
+        self.tp = tp
+        self.sp = sp
+        self.pp = pp
+        self.zero1 = zero1
+        self.notes = tuple(notes)
+
+    def fingerprint(self):
+        # Process structure is part of the program identity, not just
+        # the axes: the compiled step branches on the process count
+        # (loss slicing, buffer donation — single-process only), so an
+        # 8-device/1-process and an 8-device/2-process pure-DP world
+        # must NOT share a fingerprint even though their meshes match.
+        body = ",".join(f"{name}={size}" for name, size in self.axes)
+        if self.process_grouped:
+            body += "|pg"
+        if self.topology.n_processes > 1:
+            body += f"|p{self.topology.n_processes}"
+        return body
+
+    def axis_sizes(self):
+        return dict(self.axes)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, WorldSpec)
+            and self.fingerprint() == other.fingerprint()
+        )
+
+    def __hash__(self):
+        return hash(self.fingerprint())
+
+    def __repr__(self):
+        return f"WorldSpec({self.fingerprint()})"
+
+    def build_mesh(self) -> Mesh:
+        """Materialize the spec on the live backend. The spec's device
+        count may be a PREFIX of the visible devices (a speculated
+        smaller world compiles over the surviving prefix of the current
+        global device set)."""
+        total = math.prod(s for _, s in self.axes)
+        visible = jax.devices()
+        if total > len(visible):
+            raise ValueError(
+                f"world spec {self.fingerprint()} wants {total} devices; "
+                f"only {len(visible)} visible"
+            )
+        if self.process_grouped:
+            return make_mesh(
+                dict(self.axes),
+                devices=process_grouped_devices()[:total],
+            )
+        if total == len(visible):
+            # No explicit device list: make_mesh may then lay the axes
+            # onto the physical ICI topology (torus-neighbor rings).
+            return make_mesh(dict(self.axes))
+        return make_mesh(dict(self.axes), devices=visible[:total])
+
+
+def resolve_world_spec(
+    config: ParallelConfig,
+    topo: WorldTopology,
+    param_check: Optional[Callable[[int], list]] = None,
+) -> WorldSpec:
+    """The one deterministic (config, topology) -> WorldSpec map.
+
+    Precedence ladder (unchanged semantics from the pre-spec trainer):
+    the stage axis excludes model/seq (both lay out the intra-process
+    slice); seq drops before model when their product stops dividing;
+    zero only factors pure multi-process DP. Every degrade lands in
+    `spec.notes` as a human sentence — the trainer logs them, so the
+    fallback behavior stays as loud as the ad-hoc ladder was.
+
+    `param_check(mp) -> [violation messages]` lets the caller veto TP
+    with knowledge the resolver lacks (live param shapes vs the model
+    axis); resolution stays deterministic for a fixed check outcome.
+    """
+    notes = []
+    n, local_n = topo.n_devices, topo.local_devices
+    multi = topo.multi_process
+
+    def _dp(extra_note=None):
+        if extra_note:
+            notes.append(extra_note)
+        return WorldSpec(
+            ((DATA_AXIS, n),), False, topo, notes=notes
+        )
+
+    pp = config.pipeline_stages
+    if pp > 1 and config.has_pipeline_spec:
+        from elasticdl_tpu.parallel.pipeline import stage_axis_demand
+
+        demand = stage_axis_demand(pp)
+        why = demand.infeasible_reason(topo)
+        if why is None:
+            return WorldSpec(
+                ((DATA_AXIS, n // pp), (demand.axis, pp)),
+                multi,
+                topo,
+                pp=pp,
+                notes=notes,
+            )
+        notes.append(
+            f"pipeline_stages {pp} infeasible on this world ({why}); "
+            "running the staged model sequentially under pure data "
+            "parallelism for this world"
+        )
+        return _dp()
+
+    mp_eff = 1
+    mp = config.model_parallel
+    if mp > 1:
+        if not config.has_param_specs:
+            notes.append(
+                f"model_parallel_size {mp} requested but the model spec "
+                "has no param_specs hook; falling back to pure data "
+                "parallelism"
+            )
+        else:
+            from elasticdl_tpu.parallel.tensor_parallel import (
+                model_axis_demand,
+            )
+
+            demand = model_axis_demand(mp)
+            why = demand.infeasible_reason(topo)
+            bad = param_check(mp) if param_check is not None and not why \
+                else []
+            if why is not None:
+                notes.append(
+                    f"model_parallel_size {mp} infeasible on this world "
+                    f"({why}); falling back to pure data parallelism "
+                    "for this world"
+                )
+            elif bad:
+                notes.append(
+                    f"param_specs incompatible with model_parallel_size "
+                    f"{mp} ({'; '.join(bad[:3])}); falling back to pure "
+                    "data parallelism"
+                )
+            else:
+                mp_eff = mp
+
+    sp_eff = 1
+    sp = config.context_parallel
+    if sp > 1 and config.has_context_parallel_model and not (
+        config.sp_suspended
+    ):
+        from elasticdl_tpu.parallel.ring_attention import seq_axis_demand
+
+        demand = seq_axis_demand(sp)
+        why = demand.infeasible_reason(topo, trailing=mp_eff)
+        if why is None:
+            sp_eff = sp
+        else:
+            notes.append(
+                f"context_parallel_size {sp} (x model_parallel "
+                f"{mp_eff}) infeasible on this world ({why}); running "
+                "without sequence parallelism for this world"
+            )
+
+    if mp_eff > 1 or sp_eff > 1:
+        axes = [(DATA_AXIS, n // (mp_eff * sp_eff))]
+        if mp_eff > 1:
+            axes.append((MODEL_AXIS, mp_eff))
+        if sp_eff > 1:
+            axes.append((SEQ_AXIS, sp_eff))
+        return WorldSpec(
+            axes, multi, topo, tp=mp_eff, sp=sp_eff, notes=notes
+        )
+
+    if config.zero1 and multi and local_n > 1:
+        from elasticdl_tpu.parallel.zero1 import zero_axis_demand
+
+        demand = zero_axis_demand(local_n)
+        if demand.infeasible_reason(topo) is None:
+            # Factor pure DP into (data across processes, zero within):
+            # the batch shards over both; optimizer state shards over
+            # "zero" only, staying replicated across processes.
+            return WorldSpec(
+                ((DATA_AXIS, topo.n_processes), (demand.axis, local_n)),
+                True,
+                topo,
+                zero1=True,
+                notes=notes,
+            )
+    return _dp()
 
 
 def shard_batch(batch, mesh: Mesh, axis=None):

@@ -51,6 +51,17 @@ PipelineBuild = collections.namedtuple(
 )
 
 
+def stage_axis_demand(n_stages):
+    """Pipelining's mesh-axis contribution to world resolution: an
+    intra-process "stage" axis (stage hops ride on-host ICI; every host
+    keeps the whole staged tree addressable for regroup snapshots). The
+    resolver gives the stage axis precedence and excludes model/seq —
+    all three lay out the same intra-process device slice."""
+    from elasticdl_tpu.parallel.mesh import STAGE_AXIS, AxisDemand
+
+    return AxisDemand(STAGE_AXIS, int(n_stages), intra_process=True)
+
+
 def pipeline_apply(stage_fn, stage_params, x_micro, axis_name="stage",
                    rng=None, batch_axis=None):
     """Run microbatches through the pipeline. Call INSIDE shard_map.

@@ -23,6 +23,17 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def seq_axis_demand(context_parallel):
+    """Sequence/context parallelism's mesh-axis contribution to world
+    resolution (shared by ring attention and ulysses.py — both shard the
+    same "seq" axis): intra-process, like model/stage, and the first
+    axis the resolver drops when the trailing product stops dividing a
+    world (the plain model trains identically without SP)."""
+    from elasticdl_tpu.parallel.mesh import SEQ_AXIS, AxisDemand
+
+    return AxisDemand(SEQ_AXIS, int(context_parallel), intra_process=True)
+
+
 def _block_attend(q, k, v, scale, mask=None):
     """One blockwise contribution: returns (m, l, acc) for q against this
     k/v block. q: [B,H,Sq,D]; k,v: [B,H,Sk,D]."""

@@ -15,6 +15,14 @@ import re
 from jax.sharding import PartitionSpec as P
 
 from elasticdl_tpu.common.pytree_utils import nest_at, walk_dict
+from elasticdl_tpu.parallel.mesh import MODEL_AXIS, AxisDemand
+
+
+def model_axis_demand(model_parallel):
+    """Tensor parallelism's mesh-axis contribution to world resolution:
+    an intra-process "model" axis (TP collectives ride on-host ICI and
+    params stay fully addressable for elastic regroup snapshots)."""
+    return AxisDemand(MODEL_AXIS, int(model_parallel), intra_process=True)
 
 # (path regex, spec) — first match wins; default replicated. Param shapes:
 #   qkv/kernel  [D, 3, H, Dh]   heads column-split

@@ -17,6 +17,16 @@ dim 0, everything else (scalar step counts, ragged leaves) replicates.
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from elasticdl_tpu.parallel.mesh import ZERO_AXIS, AxisDemand
+
+
+def zero_axis_demand(local_devices):
+    """ZeRO-1's mesh-axis contribution to world resolution: an
+    intra-process "zero" axis over each host's local device slice, so
+    optimizer shards die with nothing when a PEER process dies (every
+    host keeps a fully-addressable copy for regroup snapshots)."""
+    return AxisDemand(ZERO_AXIS, int(local_devices), intra_process=True)
+
 
 def weight_update_specs(opt_state, mesh, axis="data"):
     """PartitionSpec pytree for an optax state: dim-0 sharding over `axis`

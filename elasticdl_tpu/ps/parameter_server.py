@@ -38,6 +38,14 @@ class ParameterServer:
         checkpoint_dir_for_init=None,
         master_client=None,
     ):
+        # The PS compiles (ps_step/ps_local_apply): wire the persistent
+        # compilation cache before the first jit so a relaunched shard
+        # rehydrates from disk. No-op when the knob is unset.
+        from elasticdl_tpu.common.compile_cache import (
+            ensure_compile_cache,
+        )
+
+        ensure_compile_cache()
         self.ps_id = ps_id
         self.num_ps = num_ps
         self.parameters = Parameters()

@@ -55,6 +55,8 @@ import numpy as np
 from elasticdl_tpu.common import knobs
 from elasticdl_tpu.common.jax_compat import shard_map
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.observability import emit_event
+from elasticdl_tpu.observability.metrics import default_registry
 from elasticdl_tpu.parallel import broadcast, distributed
 from elasticdl_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -62,18 +64,35 @@ from elasticdl_tpu.parallel.mesh import (
     SEQ_AXIS,
     STAGE_AXIS,
     ZERO_AXIS,
+    ParallelConfig,
+    WorldTopology,
     batch_axes,
     data_parallel_size,
     data_sharding,
-    make_mesh,
     pad_batch_to_multiple,
-    process_grouped_devices,
     replicated_sharding,
+    resolve_world_spec,
     shard_batch,
 )
 from elasticdl_tpu.worker.trainer import JaxTrainer
+from elasticdl_tpu.worker.world_speculator import (
+    SpeculativeWorldCompiler,
+    speculation_enabled,
+    world_deltas,
+)
 
 logger = get_logger("worker.allreduce_trainer")
+
+# Elastic regroups by how much work they had to do: "fast" = the new
+# world resolved to the SAME world spec on a stable backend, so the
+# compiled steps (and state placement) were kept verbatim — the
+# recompile-free path; "rebuild" = mesh + steps rebuilt.
+_C_REGROUPS = default_registry().counter(
+    "edl_regroups_total",
+    "Elastic world changes absorbed, by path (fast = no re-mesh / no "
+    "re-lowering; rebuild = mesh and steps rebuilt)",
+    labelnames=("mode",),
+)
 
 DEFAULT_STEPS_PER_WORLD_CHECK = 20
 DEFAULT_MAX_COMM_RETRIES = 5
@@ -286,6 +305,17 @@ class AllReduceTrainer(JaxTrainer):
         self._rank = -1
         self._world_size = 0
         self._mesh = None
+        # The resolved WorldSpec of the current mesh: the deterministic
+        # identity regroups, compile tokens and speculation key on.
+        self._world_spec = None
+        # Test/bench seams: pin the topology the resolver sees, and the
+        # candidate topologies the speculator guesses (production derives
+        # both from the live backend / world size).
+        self._topo_override = None
+        self._topo_candidates = None
+        self._speculated = set()  # (fingerprint, real_n) already queued
+        self._last_batch_abstract = None  # (feat_abs, label_abs, real_n)
+        self._speculator = SpeculativeWorldCompiler(self.plan_step_for_spec)
         self._sharded_steps = {}  # real_n -> jitted step
         self._local_forward = None  # multi-host eval path, built lazily
         # Multi-host eval host copy, keyed on (group_id, version): an eval
@@ -338,6 +368,22 @@ class AllReduceTrainer(JaxTrainer):
             # resuming the same step the cache was made at, with different
             # weights on disk): drop the eval host copy unconditionally.
             self._eval_host_cache = None
+            if self._mesh is not None:
+                # Re-shard the restored state per the unified world
+                # spec: the base restore places leaves uncommitted
+                # (single-device default), which would silently demote a
+                # ZeRO-1/TP layout — and cost a first-step reshard —
+                # after every checkpoint resume. With the placement done
+                # here, a rejoin that restores from checkpoint dispatches
+                # its first step against warm executables immediately.
+                self._variables = jax.device_put(
+                    self._variables,
+                    self._variables_sharding(self._variables),
+                )
+                self._opt_state = jax.device_put(
+                    self._opt_state,
+                    self._opt_placement(self._opt_state),
+                )
 
     def _state_provider(self):
         # Bounded retry: with buffer donation on the step path there is a
@@ -406,10 +452,26 @@ class AllReduceTrainer(JaxTrainer):
             resp = self._await_join_gate(resp)
         self._rank = resp.rank_id
         self._world_size = resp.world_size
+        if not force and self._try_fast_regroup(resp):
+            return
         # Snapshot to host BEFORE any distributed teardown: device arrays of
         # the old world are unusable once jax.distributed re-initializes.
         host_state = self._state_provider()
         if self._multi_host:
+            # Quiesce the speculator BEFORE the backend teardown: an XLA
+            # compile still executing on the old PJRT client when
+            # ensure_world clears backends is a use-after-teardown race.
+            # cancel() first so the drained result is discarded, then a
+            # bounded wait for the in-flight compile to finish (compiles
+            # cannot be interrupted; the bound mirrors the scale the
+            # join gate already tolerates for peers' compiles).
+            self._speculator.cancel()
+            if not self._speculator.drain(timeout=120.0):
+                logger.warning(
+                    "A speculative compile is still in flight at "
+                    "distributed re-init; proceeding — the stale "
+                    "result will be discarded"
+                )
             coordinator_ip = resp.coordinator_addr.rsplit(":", 1)[0]
             distributed.ensure_world(
                 f"{coordinator_ip}:{resp.rendezvous_port}",
@@ -419,20 +481,23 @@ class AllReduceTrainer(JaxTrainer):
             )
         self._mesh = self._make_world_mesh()
         logger.info("Mesh axes: %s", dict(self._mesh.shape))
-        # Stamp the new world's fingerprint BEFORE any step rebuild: the
-        # compile tracker attributes the re-lowerings that follow to
-        # this regroup (cause=mesh_change) instead of to shape drift.
-        from elasticdl_tpu.observability import profiling
-
-        profiling.note_mesh(
-            f"t{self._mesh_salt}:epoch{resp.rendezvous_id}:"
-            f"{dict(self._mesh.shape)}",
-            world_size=resp.world_size,
-        )
         self._sharded_steps = {}
         self._local_forward = None  # compiled against the torn-down backend
         self._rebuild_pipeline_build()
         self._rebind_sp_model()
+        # Stamp the new world's fingerprint BEFORE any step (re)lowering:
+        # the compile tracker attributes what follows to this regroup
+        # (cause=mesh_change) instead of to shape drift. The token is the
+        # SPEC fingerprint, not the membership epoch — a later epoch that
+        # resolves to a mesh this process already compiled re-lowers as
+        # `rebuild` (accurate: the mesh shape did not change), and
+        # usually rehydrates from the persistent cache anyway.
+        from elasticdl_tpu.observability import profiling
+
+        profiling.note_mesh(
+            f"t{self._mesh_salt}:{self._spec_token()}",
+            world_size=resp.world_size,
+        )
         if self._multi_host and jax.process_count() > 1:
             # SPMD world: sync state through an on-mesh collective that
             # EVERY member executes right after the rendezvous, instead of
@@ -469,6 +534,98 @@ class AllReduceTrainer(JaxTrainer):
                 self._variables = None
                 self._opt_state = None
         self._group_id = resp.rendezvous_id
+        _C_REGROUPS.labels(mode="rebuild").inc()
+        emit_event(
+            "elastic_regroup",
+            mode="rebuild",
+            epoch=resp.rendezvous_id,
+            spec=self._spec_token(),
+            world_size=resp.world_size,
+        )
+        # Re-aim the speculator at this world's neighbors: guesses for
+        # worlds that did NOT form are dropped (a mid-compile guess is
+        # discarded when it finishes — never waited on). Prebuilt
+        # executables matching the world that DID form survive for
+        # _sharded_step_for to consume — but ONLY when the backend was
+        # not torn down: a multi-host regroup re-initializes
+        # jax.distributed (ensure_world clears all backends), which
+        # invalidates every live executable, so there the prebuilts are
+        # dropped wholesale and speculation's value is the warm DISK
+        # cache entries those compiles wrote.
+        self._speculated.clear()
+        keep = None if self._multi_host else self._spec_token()
+        self._speculator.cancel(keep_fingerprint=keep)
+        self._maybe_speculate()
+
+    def _spec_token(self):
+        """The current world's spec fingerprint — with a fallback to the
+        raw mesh axes for tests that monkeypatch `_make_world_mesh` past
+        the spec resolution."""
+        if self._world_spec is not None:
+            return self._world_spec.fingerprint()
+        return str(dict(self._mesh.shape)) if self._mesh else ""
+
+    def _try_fast_regroup(self, resp):
+        """The recompile-free regroup: membership moved but the world
+        resolves to the SAME spec on a stable backend (no jax.distributed
+        re-init), so mesh, compiled steps, and state placement are all
+        still valid — adopt the epoch, sync state if this rank is a
+        (re)joiner, and keep training. This is the common case for every
+        single-host elastic event (peer died / peer joined): the epoch
+        bump used to cost a full ~compile-time re-lowering for nothing.
+        """
+        if self._mesh is None or self._world_spec is None:
+            return False
+        backend_stable = not self._multi_host or (
+            resp.world_size <= 1 and not distributed.is_live()
+        )
+        if not backend_stable:
+            return False
+        new_spec = self._resolve_spec()
+        if new_spec.fingerprint() != self._world_spec.fingerprint():
+            return False
+        # A non-zero rank still aligns state with rank 0 — membership
+        # changed even though the mesh did not (this worker may BE the
+        # rejoiner, or rank 0 may have moved).
+        if self._rank != 0 and resp.coordinator_addr:
+            pulled = self._pull_from_rank0(resp.coordinator_addr)
+            if pulled is not None:
+                variables, opt_state, version = pulled
+                with self._state_lock:
+                    self._variables = jax.device_put(
+                        variables, self._variables_sharding(variables)
+                    )
+                    self._opt_state = jax.device_put(
+                        opt_state, self._opt_placement(opt_state)
+                    )
+                    self._version = version
+        self._group_id = resp.rendezvous_id
+        # Refresh the tracker's world_size with the SAME token: later
+        # compile/compile_cache_hit events carry the new membership
+        # without perturbing mesh_change attribution (the token is what
+        # classification keys on, and it did not change).
+        from elasticdl_tpu.observability import profiling
+
+        profiling.note_mesh(
+            f"t{self._mesh_salt}:{self._spec_token()}",
+            world_size=resp.world_size,
+        )
+        _C_REGROUPS.labels(mode="fast").inc()
+        emit_event(
+            "elastic_regroup",
+            mode="fast",
+            epoch=resp.rendezvous_id,
+            spec=new_spec.fingerprint(),
+            world_size=resp.world_size,
+        )
+        logger.info(
+            "World change to epoch %d absorbed without re-mesh "
+            "(spec %s unchanged): compiled steps kept",
+            resp.rendezvous_id,
+            new_spec.fingerprint(),
+        )
+        self._maybe_speculate()
+        return True
 
     def _await_join_gate(self, resp, timeout=None, poll_seconds=0.25):
         """Poll the master's join gate until the whole world of
@@ -602,152 +759,57 @@ class AllReduceTrainer(JaxTrainer):
             )
         return state
 
-    # ---------- mesh / sharding layout ----------
+    # ---------- mesh / sharding layout (via the unified world spec) ----------
+
+    def _world_topology(self):
+        """The topology world resolution sees: the live backend, unless
+        a test/bench pinned `_topo_override` to stand in for a world
+        this process is not in."""
+        if self._topo_override is not None:
+            return self._topo_override
+        return WorldTopology.current()
+
+    def _parallel_config(self):
+        """This trainer's parallel dimensions as the pure config slice
+        `resolve_world_spec` consumes — hook presence as booleans, the
+        per-world SP downgrade bit included."""
+        return ParallelConfig(
+            model_parallel=self._model_parallel_size,
+            has_param_specs=self._param_specs_fn is not None,
+            zero1=self._zero1,
+            pipeline_stages=self._pipeline_stages,
+            has_pipeline_spec=self._pipeline_spec_fn is not None,
+            context_parallel=self._context_parallel_size,
+            has_context_parallel_model=(
+                self._context_parallel_model_fn is not None
+            ),
+            sp_suspended=self._sp_suspend_once,
+        )
+
+    def _param_check(self, mp):
+        if self._variables is None:
+            return []
+        return self._spec_violations(self._variables, mp)
+
+    def _resolve_spec(self, topo=None):
+        """Deterministically resolve the WorldSpec for `topo` (default:
+        the current topology) under this trainer's config. Same config +
+        same topology always yields the same fingerprint — the property
+        the fast regroup path and the speculator are built on."""
+        return resolve_world_spec(
+            self._parallel_config(),
+            topo if topo is not None else self._world_topology(),
+            param_check=self._param_check,
+        )
 
     def _make_world_mesh(self):
-        n = len(jax.devices())
-        local_n = jax.local_device_count()
-        multi_proc = jax.process_count() > 1
-        pp = self._pipeline_stages
-        if pp > 1:
-            # Same feasibility ladder as the model axis below: the stage
-            # axis must divide the devices, and in multi-host worlds must
-            # stay inside one process (stage hops ride intra-host ICI and
-            # every process keeps fully-addressable params for regroup
-            # snapshots). Infeasible worlds degrade to pure DP — the
-            # staged param tree keeps training through the schedule-free
-            # sequential apply (see _pipeline_step_fn).
-            if n % pp != 0:
-                logger.warning(
-                    "pipeline_stages %d does not divide %d devices; "
-                    "running the staged model sequentially under pure "
-                    "data parallelism for this world", pp, n,
-                )
-            elif multi_proc and local_n % pp != 0:
-                logger.warning(
-                    "pipeline_stages %d does not divide the %d local "
-                    "devices of each process; multi-host pipelining "
-                    "requires an intra-process stage axis — running the "
-                    "staged model sequentially under pure data "
-                    "parallelism for this world", pp, local_n,
-                )
-            elif multi_proc:
-                return make_mesh(
-                    {DATA_AXIS: -1, STAGE_AXIS: pp},
-                    devices=process_grouped_devices(),
-                )
-            else:
-                return make_mesh({DATA_AXIS: -1, STAGE_AXIS: pp})
-            return make_mesh()
-        mp = self._tp_feasible(n, local_n, multi_proc)
-        sp = self._sp_feasible(n, local_n, multi_proc, mp)
-        if mp > 1 or sp > 1:
-            axes = {DATA_AXIS: -1}
-            if mp > 1:
-                axes[MODEL_AXIS] = mp
-            if sp > 1:
-                axes[SEQ_AXIS] = sp
-            if multi_proc:
-                # Explicit process-grouped device order: the flat reshape
-                # (data, model, seq) slices each trailing-axes group out
-                # of ONE process's devices (divisibility checked by the
-                # feasibility helpers). mesh_utils reordering could break
-                # that, so the explicit device list skips it.
-                return make_mesh(axes, devices=process_grouped_devices())
-            return make_mesh(axes)
-        if self._zero1 and multi_proc and local_n > 1:
-            # Factor pure DP into (data across processes, zero within):
-            # the batch shards over both axes; optimizer state shards over
-            # "zero" only, staying replicated across processes — saving
-            # local_n x optimizer memory while every process keeps a
-            # fully-addressable copy for elastic regroups.
-            return make_mesh(
-                {DATA_AXIS: jax.process_count(), ZERO_AXIS: local_n},
-                devices=process_grouped_devices(),
-            )
-        return make_mesh()
-
-    def _tp_feasible(self, n, local_n, multi_proc):
-        """The effective model-parallel width for this world: the
-        configured size when every precondition holds, else 1 (with a
-        warning naming the failed one) so the mesh degrades to DP instead
-        of silently duplicating compute over a model axis."""
-        mp = self._model_parallel_size
-        if mp <= 1:
-            return 1
-        if self._param_specs_fn is None:
-            # A model axis without param layouts would just duplicate the
-            # same DP computation mp times — half (or worse) of the
-            # cluster doing redundant work. Take the DP fallback instead.
-            logger.warning(
-                "model_parallel_size %d requested but the model spec has "
-                "no param_specs hook; falling back to pure data "
-                "parallelism", mp,
-            )
-            return 1
-        if n % mp != 0:
-            logger.warning(
-                "model_parallel_size %d does not divide %d devices; "
-                "falling back to pure data parallelism for this world",
-                mp, n,
-            )
-            return 1
-        if multi_proc and local_n % mp != 0:
-            # Composition invariant (module docstring): the model axis
-            # must stay inside one process so params remain fully
-            # addressable for regroup snapshots (and TP collectives stay
-            # on-host ICI).
-            logger.warning(
-                "model_parallel_size %d does not divide the %d local "
-                "devices of each process; multi-host TP requires an "
-                "intra-process model axis — falling back to pure data "
-                "parallelism for this world", mp, local_n,
-            )
-            return 1
-        bad = (
-            self._spec_violations(self._variables, mp)
-            if self._variables is not None
-            else []
-        )
-        if bad:
-            # Keeping a (data=n/mp, model=mp) mesh with replicated
-            # params would silently run mp-way duplicated compute;
-            # rebuild a genuine pure-DP mesh instead.
-            logger.warning(
-                "param_specs incompatible with model_parallel_size "
-                "%d (%s); falling back to pure data parallelism",
-                mp, "; ".join(bad[:3]),
-            )
-            return 1
-        return mp
-
-    def _sp_feasible(self, n, local_n, multi_proc, mp_eff):
-        """The effective sequence-parallel width: the configured size
-        when the combined trailing axes (model x seq) divide the device
-        counts, else 1 — the seq axis drops first, keeping any feasible
-        TP (the plain model trains identically without SP; TP needs its
-        param layout)."""
-        sp = self._context_parallel_size
-        if sp <= 1 or self._sp_suspend_once:
-            return 1
-        trailing = mp_eff * sp
-        if n % trailing != 0:
-            logger.warning(
-                "context_parallel_size %d (x model_parallel %d) does "
-                "not divide %d devices; running without sequence "
-                "parallelism for this world", sp, mp_eff, n,
-            )
-            return 1
-        if multi_proc and local_n % trailing != 0:
-            logger.warning(
-                "context_parallel_size %d (x model_parallel %d) does "
-                "not divide the %d local devices of each process; "
-                "multi-host SP requires intra-process model/seq axes — "
-                "running without sequence parallelism for this world",
-                sp, mp_eff, local_n,
-            )
-            return 1
-        return sp
+        spec = self._resolve_spec()
+        for note in spec.notes:
+            # Degrades stay as loud as the old ad-hoc ladder's warnings:
+            # a silently dropped axis is duplicated compute.
+            logger.warning("%s", note)
+        self._world_spec = spec
+        return spec.build_mesh()
 
     def _spec_violations(self, variables, mp):
         """Sharded dims that don't divide the model-axis size, as human
@@ -787,21 +849,47 @@ class AllReduceTrainer(JaxTrainer):
         )
         return bad
 
-    def _opt_placement(self, opt_tree):
-        """Optimizer-state layout on the current mesh: ZeRO-1 dim-0
-        sharding when enabled (pure DP) — over the whole data axis in a
-        single-process world, over the intra-process "zero" axis in a
-        multi-host one — replicated otherwise (under TP the initial
-        replication is resharded by GSPMD to mirror the param layout
-        after the first step)."""
-        if self._zero1 and not self._tp_active() and not self._sp_active():
+    @staticmethod
+    def _donation_for(opt_sh, n_processes):
+        """The ONE donation rule, shared by the live build and the
+        speculative planner so a consumed executable aliases exactly
+        like a locally-compiled one. Donate (variables, opt_state) in
+        single-process worlds only (multi-process donation would turn a
+        failed collective into silent zero-broadcast corruption — see
+        the live build's comment). opt_state donation additionally
+        requires a PINNED in/out layout: when GSPMD owns it (opt_sh
+        None, the TP/pipeline paths) the propagated output layout can't
+        alias the replicated input buffer (XLA rejects the size
+        mismatch), so only the variables donate there."""
+        if n_processes != 1:
+            return ()
+        return (0,) if opt_sh is None else (0, 1)
+
+    def _opt_placement(self, opt_tree, mesh=None, spec=None):
+        """Optimizer-state layout: ZeRO-1 dim-0 sharding when enabled
+        (pure DP) — over the whole data axis in a single-process world,
+        over the intra-process "zero" axis in a multi-host one —
+        replicated otherwise (under TP the initial replication is
+        resharded by GSPMD to mirror the param layout after the first
+        step). Default: the LIVE world; pass (mesh, spec) to decide for
+        a candidate world instead (speculative planning) — one decision
+        ladder for both, so the planner cannot drift from the build."""
+        live = mesh is None
+        if live:
+            mesh = self._mesh
+            tp_or_sp = self._tp_active() or self._sp_active()
+            n_processes = jax.process_count()
+        else:
+            tp_or_sp = spec.tp > 1 or spec.sp > 1
+            n_processes = spec.topology.n_processes
+        if self._zero1 and not tp_or_sp:
             from elasticdl_tpu.parallel.zero1 import (
                 weight_update_shardings,
             )
 
-            if ZERO_AXIS in self._mesh.shape:
+            if ZERO_AXIS in mesh.shape:
                 axis = ZERO_AXIS
-            elif jax.process_count() == 1:
+            elif n_processes == 1:
                 axis = "data"
             else:
                 # Multi-process world whose mesh got no zero axis (one
@@ -811,16 +899,16 @@ class AllReduceTrainer(JaxTrainer):
                 # the exact failure the composition invariant exists to
                 # prevent. Replicate instead; there is no intra-process
                 # slice to save memory over anyway.
-                logger.warning(
-                    "zero1 has no effect in this world: each process "
-                    "holds one device, so there is no intra-process "
-                    "axis to shard optimizer state over"
-                )
-                return replicated_sharding(self._mesh)
-            return weight_update_shardings(
-                opt_tree, self._mesh, axis=axis
-            )
-        return replicated_sharding(self._mesh)
+                if live:  # a planner would spam this per candidate
+                    logger.warning(
+                        "zero1 has no effect in this world: each "
+                        "process holds one device, so there is no "
+                        "intra-process axis to shard optimizer state "
+                        "over"
+                    )
+                return replicated_sharding(mesh)
+            return weight_update_shardings(opt_tree, mesh, axis=axis)
+        return replicated_sharding(mesh)
 
     def _tp_active(self):
         return (
@@ -966,6 +1054,23 @@ class AllReduceTrainer(JaxTrainer):
         # variants, so the cache stays small in practice.
         key = (real_n, padded_n)
         step = self._sharded_steps.get(key)
+        if step is None and self._world_spec is not None:
+            # A speculative guess for exactly this world may already be
+            # compiled: consume the executable instead of cold-compiling.
+            # Donation semantics ride along — the executable was lowered
+            # from the same jit parameters the build below would use.
+            fingerprint = self._world_spec.fingerprint()
+            prebuilt = self._speculator.take(fingerprint, key)
+            if prebuilt is not None:
+                logger.info(
+                    "Consuming speculatively compiled step for world %s "
+                    "%s", fingerprint, key,
+                )
+                emit_event(
+                    "aot_consumed", spec=fingerprint, shape_key=list(key)
+                )
+                self._sharded_steps[key] = prebuilt
+                return prebuilt
         if step is None:
             repl = replicated_sharding(self._mesh)
             data = data_sharding(self._mesh)
@@ -1036,14 +1141,7 @@ class AllReduceTrainer(JaxTrainer):
                 if self._tp_active() or self._pp_active()
                 else self._opt_placement(self._opt_state)
             )
-            donate = ()
-            if jax.process_count() == 1:
-                # opt_state donation additionally requires a PINNED
-                # in/out layout: when GSPMD owns it (opt_sh None, the
-                # TP/pipeline paths) the propagated output layout can't
-                # alias the replicated input buffer (XLA rejects the
-                # size mismatch), so only the variables donate there.
-                donate = (0,) if opt_sh is None else (0, 1)
+            donate = self._donation_for(opt_sh, jax.process_count())
             from elasticdl_tpu.observability.profiling import tracked_jit
 
             step = tracked_jit(
@@ -1057,10 +1155,191 @@ class AllReduceTrainer(JaxTrainer):
             self._sharded_steps[key] = step
         return step
 
-    def _quantized_step_fn(self):
+    # ---------- speculative AOT planning ----------
+
+    def plan_step_for_spec(self, spec, real_n):
+        """AOT plan for a world this trainer is NOT currently in — the
+        speculator's callback. Returns (shape_key, jitted step, abstract
+        args) or None when the candidate world's step cannot be planned
+        off-world: the pipeline/SP paths are bound to per-world hook
+        state (their builds close over the live mesh), and nothing can
+        be planned before the first batch reveals its shapes."""
+        if self._pipeline_build is not None or self._sp_model is not None:
+            return None
+        if spec.pp > 1 or spec.sp > 1:
+            return None
+        if self._variables is None or self._last_batch_abstract is None:
+            return None
+        mesh = spec.build_mesh()
+        repl = replicated_sharding(mesh)
+        data = data_sharding(mesh)
+        multiple = data_parallel_size(mesh)
+        padded_n = -(-real_n // multiple) * multiple
+        # Semantics follow the CANDIDATE world's process count, not the
+        # live backend's: the plan must compile byte-what the live build
+        # would compile once that world forms (slice_to, donation, and
+        # the ZeRO axis below all branch on it).
+        slice_to = real_n if spec.topology.n_processes == 1 else None
+        if self._quantized_grads:
+            step_fn = self._quantized_step_fn(
+                mesh=mesh, tp=spec.tp > 1
+            )
+        else:
+
+            def step_fn(variables, opt_state, rng, features, labels):
+                return self._step_body(
+                    variables, opt_state, rng, features, labels,
+                    slice_to,
+                )
+
+        var_sh, opt_sh, donate = self._plan_shardings(mesh, spec)
+        from elasticdl_tpu.observability.profiling import tracked_jit
+
+        step = tracked_jit(
+            step_fn,
+            name="allreduce_step",
+            key_argnums=(3, 4),
+            in_shardings=(var_sh, opt_sh, repl, data, data),
+            out_shardings=(var_sh, opt_sh, repl),
+            donate_argnums=donate,
+        )
+        abstract = self._abstract_step_args(padded_n)
+        if abstract is None:
+            return None
+        return (real_n, padded_n), step, abstract
+
+    def _plan_shardings(self, mesh, spec):
+        """(variables sharding, opt sharding, donate_argnums) for a
+        candidate (mesh, spec): the same decision ladder as the live
+        build — opt placement and donation come from the SHARED helpers
+        (`_opt_placement` in candidate mode, `_donation_for`), so a
+        consumed executable is indistinguishable from a locally-compiled
+        one, donation included."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        tp = spec.tp > 1
+        if tp:
+            var_sh = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s),
+                self._param_specs_fn(self._variables),
+                is_leaf=lambda v: isinstance(v, PartitionSpec),
+            )
+            opt_sh = None  # GSPMD propagates the param layout
+        else:
+            var_sh = replicated_sharding(mesh)
+            opt_sh = self._opt_placement(
+                self._opt_state, mesh=mesh, spec=spec
+            )
+        donate = self._donation_for(opt_sh, spec.topology.n_processes)
+        return var_sh, opt_sh, donate
+
+    def _abstract_step_args(self, padded_n):
+        """ShapeDtypeStruct tree for (variables, opt_state, rng,
+        features, labels) with the batch re-padded to the candidate
+        world's multiple — what `.lower()` needs to compile a step
+        without concrete arrays."""
+
+        def abs_of(a):
+            shape = tuple(getattr(a, "shape", ()))
+            dtype = getattr(a, "dtype", np.float32)
+            return jax.ShapeDtypeStruct(shape, dtype)
+
+        def repad(s):
+            return jax.ShapeDtypeStruct(
+                (padded_n,) + tuple(s.shape[1:]), s.dtype
+            )
+
+        feat_abs, label_abs, _ = self._last_batch_abstract
+        try:
+            return (
+                jax.tree_util.tree_map(abs_of, self._variables),
+                jax.tree_util.tree_map(abs_of, self._opt_state),
+                abs_of(
+                    jax.random.fold_in(self._step_rng_base, 0)
+                ),
+                jax.tree_util.tree_map(repad, feat_abs),
+                jax.tree_util.tree_map(repad, label_abs),
+            )
+        except Exception:  # deleted/odd leaves mid-transition
+            return None
+
+    def _note_batch_abstract(self, features, labels, real_n):
+        self._last_batch_abstract = (
+            jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(
+                    tuple(a.shape), a.dtype
+                ),
+                features,
+            ),
+            jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(
+                    tuple(a.shape), a.dtype
+                ),
+                labels,
+            ),
+            real_n,
+        )
+
+    def _maybe_speculate(self):
+        """Queue AOT compiles for the worlds a regroup is most likely to
+        land on next. Cheap when there is nothing to do: candidates are
+        deduped per (spec, batch shape) and single-host worlds have no
+        candidates at all (their spec is membership-invariant — the fast
+        regroup path absorbs epoch bumps for free)."""
+        if not speculation_enabled():
+            return
+        if self._world_spec is None or self._last_batch_abstract is None:
+            return
+        real_n = self._last_batch_abstract[2]
+        current = self._world_spec.fingerprint()
+        specs = []
+        for topo in self._candidate_topologies():
+            # Dedup on (topology, shape) BEFORE resolving: this runs
+            # every step, and resolution under TP walks the whole
+            # parameter tree (param_check) — pay that once per new
+            # candidate, not per minibatch.
+            tag = (topo, real_n)
+            if tag in self._speculated:
+                continue
+            self._speculated.add(tag)
+            if topo.n_devices < 1 or topo.n_devices > len(jax.devices()):
+                # Worlds bigger than the live backend can't be built
+                # here; their regroup is covered by the persistent
+                # compilation cache instead.
+                continue
+            try:
+                spec = self._resolve_spec(topo)
+            except Exception:
+                continue
+            if spec.fingerprint() == current:
+                continue
+            specs.append(spec)
+        if specs:
+            self._speculator.submit(specs, real_n)
+
+    def _candidate_topologies(self):
+        if self._topo_candidates is not None:
+            return list(self._topo_candidates)
+        if not self._multi_host or self._world_size <= 1:
+            # Single-host worlds: the mesh is device-determined; every
+            # membership epoch resolves to the same spec, so there is
+            # nothing to guess.
+            return []
+        local = jax.local_device_count()
+        out = []
+        for delta in range(1, world_deltas() + 1):
+            for w in (
+                self._world_size - delta, self._world_size + delta
+            ):
+                if w >= 1 and w != self._world_size:
+                    out.append(WorldTopology(w * local, local, w))
+        return out
+
+    def _quantized_step_fn(self, mesh=None, tp=None):
         """Step with the data-axis gradient reduction quantized to int8
-        (EQuARX-style — see the constructor comment). Two deployments,
-        one body:
+        (EQuARX-style — see the constructor comment). `mesh`/`tp`
+        default to the live world; the speculative planner passes a
+        candidate world's instead. Two deployments, one body:
 
         - Pure DP (possibly factored {data, zero}): shard_map manual over
           every batch axis; any intra-host zero leg reduces exact f32 on
@@ -1087,8 +1366,8 @@ class AllReduceTrainer(JaxTrainer):
 
         from elasticdl_tpu.parallel.quantized import quantized_pmean
 
-        mesh = self._mesh
-        tp = self._tp_active()
+        mesh = self._mesh if mesh is None else mesh
+        tp = self._tp_active() if tp is None else tp
         axes = (DATA_AXIS,) if tp else batch_axes(mesh)
         sm_kwargs = {"axis_names": {DATA_AXIS}} if tp else {}
 
@@ -1323,6 +1602,11 @@ class AllReduceTrainer(JaxTrainer):
         padded_f, real_n = pad_batch_to_multiple(features, multiple)
         padded_l, _ = pad_batch_to_multiple(labels, multiple)
         padded_n = jax.tree_util.tree_leaves(padded_f)[0].shape[0]
+        # Remember this batch's shape signature and (maybe) queue AOT
+        # compiles for neighboring worlds — both are cheap bookkeeping;
+        # actual speculative compilation runs in the background thread.
+        self._note_batch_abstract(features, labels, real_n)
+        self._maybe_speculate()
         step = self._sharded_step_for(real_n, padded_n)
         # Derive the dropout key from the SHARED model version, not a local
         # split chain: a joining worker's split count differs from the
@@ -1406,6 +1690,7 @@ class AllReduceTrainer(JaxTrainer):
         return jax.tree_util.tree_map(np.asarray, outputs)
 
     def close(self):
+        self._speculator.stop()
         self._broadcast_server.stop()
         if self._multi_host:
             distributed.leave_world()

@@ -65,6 +65,14 @@ class JaxTrainer(Trainer):
     """
 
     def __init__(self, model, loss_fn, optimizer_spec, seed=0):
+        # Persistent compilation cache (recompile-free elasticity):
+        # wired before the first jit so even bare trainers (tests,
+        # benches) rehydrate executables when the knob names a dir.
+        from elasticdl_tpu.common.compile_cache import (
+            ensure_compile_cache,
+        )
+
+        ensure_compile_cache()
         self._model = model
         self._loss_fn = loss_fn
         self._optimizer_spec = optimizer_spec

@@ -391,7 +391,7 @@ def test_zero1_multihost_layout_matches_replicated():
     import jax
 
     from elasticdl_tpu.models.transformer import transformer_lm as tlm
-    from elasticdl_tpu.parallel.mesh import DATA_AXIS, ZERO_AXIS, make_mesh
+    from elasticdl_tpu.parallel.mesh import ZERO_AXIS, WorldTopology
 
     cfg = tlm.LMConfig(
         vocab=64, d_model=32, n_heads=4, n_layers=1, max_len=16,
@@ -412,8 +412,12 @@ def test_zero1_multihost_layout_matches_replicated():
                 zero1=zero1, seed=3,
             )
             if force_two_axis:
-                t._make_world_mesh = lambda: make_mesh(
-                    {DATA_AXIS: 2, ZERO_AXIS: 4}
+                # Stand in for a 2-process world of 4 local devices:
+                # world resolution then factors pure DP into the
+                # {data: 2, zero: 4} mesh exactly as a real multi-host
+                # ZeRO-1 worker would build it.
+                t._topo_override = WorldTopology(
+                    n_devices=8, local_devices=4, n_processes=2
                 )
             try:
                 losses = [

@@ -450,10 +450,16 @@ def test_multihost_two_workers_pipeline_1f1b(tmp_path, monkeypatch):
 
     # De-flake: on a loaded 1-core box the ~6.5 s step compile (times
     # several lowerings) outlasts the old fixed 90 s join gate and the
-    # ranks churn membership. The registered knob scales the gate; the
-    # workers inherit it through the instance manager's env forwarding.
-    # (In-process rejoins additionally auto-scale off the compile
-    # tracker's measured floor — see join_gate_budget.)
+    # ranks churn membership. Two layers of defense: (1) the workers
+    # share ONE persistent compile cache dir, so the two ranks (and any
+    # relaunch) lower the identical SPMD program into/out of warm disk
+    # entries — under full-suite load the compile floor (and with it
+    # the auto-derived join gate) shrinks to the trace+lower time after
+    # the first rank's misses; (2) the registered gate knob stays
+    # pinned at 240 s as the fallback for the cold-cache worst case.
+    monkeypatch.setenv(
+        "ELASTICDL_COMPILE_CACHE_DIR", str(tmp_path / "compile_cache")
+    )
     monkeypatch.setenv("ELASTICDL_JOIN_GATE_SECONDS", "240")
 
     sys.path.insert(0, os.path.join(REPO, "tools"))

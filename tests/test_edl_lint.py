@@ -1151,7 +1151,9 @@ def test_blocking_under_lock_negative_and_suppression(tmp_path):
 # ---------------------------------------------------------------------------
 
 _MESH_TREE_OK = {
-    "elasticdl_tpu/parallel/build.py": """
+    # Constructions live in parallel/mesh.py — the one module the
+    # spec-API check exempts (everywhere else a Mesh birth is flagged).
+    "elasticdl_tpu/parallel/mesh.py": """
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     def build(devices):
@@ -1207,18 +1209,24 @@ def test_mesh_spec_flags_class_level_drift(tmp_path):
 
 def test_mesh_spec_incremental_dict_and_suppression(tmp_path):
     files = dict(_MESH_TREE_OK)
-    # Incremental axis dict (the _make_world_mesh idiom) declares the
-    # axis; and a suppressed typo stays quiet.
-    files["elasticdl_tpu/worker/incr.py"] = """
-    from jax.sharding import PartitionSpec as P
+    # Incremental axis dict (the old _make_world_mesh idiom, now inside
+    # the spec API module itself) declares the axis; and a suppressed
+    # typo in a consumer module stays quiet.
+    files["elasticdl_tpu/parallel/mesh.py"] = (
+        files["elasticdl_tpu/parallel/mesh.py"]
+        + """
+    def make_mesh(axes=None):
+        return Mesh((), axis_names=tuple(axes or {"data": 1}))
 
-    from elasticdl_tpu.parallel.mesh import make_mesh
-
-    def build(tp):
+    def build_incr(tp):
         axes = {"data": -1}
         if tp > 1:
             axes["seq"] = tp
         return make_mesh(axes)
+    """
+    )
+    files["elasticdl_tpu/worker/incr.py"] = """
+    from jax.sharding import PartitionSpec as P
 
     def spec():
         return P("seq")
@@ -1226,6 +1234,33 @@ def test_mesh_spec_incremental_dict_and_suppression(tmp_path):
     def odd():
         # edl-lint: disable=mesh-spec-consistency
         return P("weird")
+    """
+    project = make_project(tmp_path, files)
+    assert run_rule(project, "mesh-spec-consistency") == []
+
+
+def test_mesh_spec_flags_construction_outside_spec_api(tmp_path):
+    files = dict(_MESH_TREE_OK)
+    files["elasticdl_tpu/worker/rogue.py"] = """
+    from elasticdl_tpu.parallel.mesh import make_mesh
+
+    def build_my_own():
+        return make_mesh({"data": 8})
+    """
+    project = make_project(tmp_path, files)
+    assert "mesh-outside-api:build_my_own" in keys(
+        run_rule(project, "mesh-spec-consistency")
+    )
+
+
+def test_mesh_spec_construction_outside_api_suppressible(tmp_path):
+    files = dict(_MESH_TREE_OK)
+    files["elasticdl_tpu/worker/rogue.py"] = """
+    from elasticdl_tpu.parallel.mesh import make_mesh
+
+    def build_my_own():
+        # edl-lint: disable=mesh-spec-consistency
+        return make_mesh({"data": 8})
     """
     project = make_project(tmp_path, files)
     assert run_rule(project, "mesh-spec-consistency") == []

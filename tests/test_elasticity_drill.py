@@ -344,3 +344,71 @@ def test_multi_host_two_process_world(tmp_path):
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank}:\n{out[-3000:]}"
         assert f"MH_OK {rank}" in out
+
+
+def test_worker_kill_warm_cache_is_recompile_free(tmp_path):
+    """The recompile-free elasticity acceptance drill (ISSUE 15): a
+    worker-kill with the persistent compile cache armed must show
+
+    - `edl_compile_total{cause="mesh_change"}` FLAT — no elastic epoch
+      (the kill, the rejoin) re-lowers any survivor's step, because the
+      world resolves to the same WorldSpec and the fast regroup path
+      keeps the compiled steps;
+    - the survivor absorbing membership through `elastic_regroup`
+      events with mode="fast";
+    - the RELAUNCHED worker rehydrating its step from the disk cache
+      its first incarnation populated (`compile_cache_hit` events)
+      instead of paying a cold XLA compile — compile is no longer the
+      rejoin."""
+    from elasticdl_tpu.data.recordfile import RecordFileWriter
+    from elasticdl_tpu.observability.events import read_events
+
+    data = str(tmp_path / "linear.edlr")
+    with RecordFileWriter(data) as w:
+        for r in test_module.make_linear_records(256):
+            w.write(r)
+    obs_dir = str(tmp_path / "obs")
+    cache_dir = str(tmp_path / "compile_cache")
+    result = run_drill(
+        data,
+        model_zoo=os.path.join(REPO, "tests"),
+        model_def="test_module",
+        num_workers=2,
+        num_ps=0,
+        strategy="AllreduceStrategy",
+        num_epochs=300,
+        env_overrides={
+            "JAX_PLATFORMS": "cpu",
+            "ELASTICDL_OBS_DIR": obs_dir,
+            "ELASTICDL_COMPILE_CACHE_DIR": cache_dir,
+        },
+        timeout=420,
+    )
+    assert result["completed"], result.get("log_tail", "")[-1500:]
+    assert result["relaunched"], "worker was never relaunched"
+    records = read_events(os.path.join(obs_dir, "events.jsonl"))
+
+    # 1) mesh_change flat: NO lowering in the whole drill was caused by
+    # a world change — membership epochs no longer reshape the mesh.
+    mesh_changes = [
+        r for r in records
+        if r["kind"] == "compile" and r.get("cause") == "mesh_change"
+    ]
+    assert mesh_changes == [], mesh_changes
+
+    # 2) the survivors absorbed the kill/rejoin epochs on the fast path.
+    fast = [
+        r for r in records
+        if r["kind"] == "elastic_regroup" and r.get("mode") == "fast"
+    ]
+    assert fast, [r for r in records if r["kind"] == "elastic_regroup"]
+
+    # 3) the relaunched worker rehydrated from the warm cache: its
+    # re-lowerings landed as compile_cache_hit, and its training step
+    # specifically never cold-compiled a second time. (Worker roles
+    # each appear once per incarnation; the cache was populated by the
+    # first incarnations before the SIGKILL.)
+    hits = [r for r in records if r["kind"] == "compile_cache_hit"]
+    assert any(r.get("fn") == "allreduce_step" for r in hits), (
+        [r for r in records if r["kind"].startswith("compile")][-20:]
+    )

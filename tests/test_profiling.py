@@ -398,8 +398,10 @@ def test_step_report_from_obs_dir(tmp_path):
 
 
 # ---------------------------------------------------------------------------
-# the elastic acceptance path: a world change shows up as a mesh_change
-# compile with nonzero compile seconds on the master's aggregated view
+# the elastic acceptance path: a world change that RESHAPES the mesh shows
+# up as a mesh_change compile with nonzero compile seconds on the master's
+# aggregated view — while an epoch bump that resolves to the same world
+# spec re-lowers NOTHING (the recompile-free fast path)
 # ---------------------------------------------------------------------------
 
 
@@ -408,6 +410,7 @@ def test_world_change_emits_mesh_change_compile(tmp_path):
     from elasticdl_tpu.observability.aggregator import (
         TelemetryAggregator,
     )
+    from elasticdl_tpu.parallel.mesh import WorldTopology
     from elasticdl_tpu.worker.allreduce_trainer import AllReduceTrainer
     from elasticdl_tpu.worker.master_client import MasterClient
 
@@ -433,12 +436,23 @@ def test_world_change_emits_mesh_change_compile(tmp_path):
             try:
                 t.train_minibatch(x, y)
                 epoch_before = t._group_id
-                # A second worker joins: membership epoch bumps, the
-                # next world check re-meshes and re-lowers the step.
+                compiles_before = profiling.tracker().snapshot()[0]
+                # A second worker joins: membership epoch bumps, but the
+                # world resolves to the SAME spec on this single-host
+                # backend — the fast path must keep the compiled step.
                 m["membership"].add_worker_host("10.0.0.2:9999")
                 t.train_minibatch(x, y)
-                t.train_minibatch(x, y)
                 assert t._group_id > epoch_before
+                assert (
+                    profiling.tracker().snapshot()[0] == compiles_before
+                ), "same-spec world change re-lowered the step"
+                # Now the world RESHAPES (stand-in for a device-count
+                # change): 8 -> 7 devices; the rebuild re-lowers with
+                # cause=mesh_change.
+                t._topo_override = WorldTopology(7, 7, 1)
+                m["membership"].add_worker_host("10.0.0.3:9999")
+                t.train_minibatch(x, y)
+                t.train_minibatch(x, y)
             finally:
                 profiling.note_mesh("", world_size=0)
                 t.close()
@@ -448,8 +462,12 @@ def test_world_change_emits_mesh_change_compile(tmp_path):
             for e in cap.events("compile")
             if e["cause"] == "mesh_change"
         ]
+        regroups = cap.events("elastic_regroup")
     assert mesh_events, cap.events("compile")
     assert any(e["fn"] == "allreduce_step" for e in mesh_events)
+    # Both regroup paths were taken, in order: the same-spec epoch bump
+    # absorbed fast, the reshaped world rebuilt.
+    assert [r["mode"] for r in regroups] == ["rebuild", "fast", "rebuild"]
     assert _seconds_for("allreduce_step") > baseline_seconds
 
     # The master's aggregated view: scraping this worker's registry must

@@ -1,0 +1,317 @@
+"""Recompile-free elasticity: the regroup fast path, the speculative
+AOT world compiler, and the persistent compilation cache.
+
+The contract under test (ISSUE 15 / docs/ELASTICITY.md): a membership
+epoch that does not reshape the mesh re-lowers NOTHING; a reshaping
+regroup consumes a speculatively prebuilt executable when the guess
+landed (with donation preserved), abandons it cleanly when it did not,
+and never blocks the step loop on a background compile; a relaunched
+process with a warm cache dir rehydrates its step from disk instead of
+cold-compiling."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+import tests.test_module as test_module
+from elasticdl_tpu.observability import profiling
+from elasticdl_tpu.parallel.mesh import WorldTopology
+from elasticdl_tpu.worker.allreduce_trainer import AllReduceTrainer
+from elasticdl_tpu.worker.master_client import MasterClient
+from elasticdl_tpu.worker.world_speculator import SpeculativeWorldCompiler
+from tests.test_utils import start_master
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _batch(n, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, test_module.FEATURE_DIM)).astype(np.float32)
+    y = (x @ test_module.TRUE_W + test_module.TRUE_B).astype(np.float32)
+    return x, y
+
+
+def _trainer(master, **kw):
+    mc = MasterClient(
+        master["addr"], worker_id=0, worker_host="127.0.0.1"
+    )
+    t = AllReduceTrainer(
+        test_module.custom_model(),
+        test_module.loss,
+        test_module.optimizer(),
+        mc,
+        steps_per_world_check=1,
+        **kw,
+    )
+    return t, mc
+
+
+def test_fast_regroup_keeps_compiled_steps():
+    """Epoch bump, same spec: the steps dict is untouched (same jitted
+    objects), the compile tracker records nothing, and training carries
+    state straight through."""
+    with start_master(
+        training_shards={"f": (0, 100)}, with_membership=True
+    ) as m:
+        t, mc = _trainer(m)
+        try:
+            x, y = _batch(16)
+            t.train_minibatch(x, y)
+            version = t.get_model_version()
+            steps_before = dict(t._sharded_steps)
+            compiles_before = profiling.tracker().snapshot()[0]
+            m["membership"].add_worker_host("10.0.0.2:9999")
+            t.train_minibatch(x, y)
+            t.train_minibatch(x, y)
+            assert t.world_size == 2
+            for key, step in steps_before.items():
+                assert t._sharded_steps[key] is step
+            assert profiling.tracker().snapshot()[0] == compiles_before
+            assert t.get_model_version() == version + 2
+        finally:
+            t.close()
+            mc.close()
+
+
+def test_speculative_compile_consumed_on_regroup():
+    """The trainer guesses the 8-device world while training in a
+    7-device one; the regroup back to 8 consumes the prebuilt
+    executable — no synchronous compile, donation intact."""
+    with start_master(
+        training_shards={"f": (0, 100)}, with_membership=True
+    ) as m:
+        t, mc = _trainer(m)
+        try:
+            x, y = _batch(16)
+            t._topo_override = WorldTopology(7, 7, 1)
+            t._topo_candidates = [WorldTopology(8, 8, 1)]
+            t.train_minibatch(x, y)
+            assert t._speculator.drain(90), "speculator never idled"
+            assert ("data=8", (16, 16)) in t._speculator.prebuilt_keys()
+            # Timing baseline: warm steps in the current world.
+            for _ in range(2):
+                t.train_minibatch(x, y)
+            t0 = time.perf_counter()
+            for _ in range(3):
+                import jax
+
+                jax.block_until_ready(t.train_minibatch(x, y)[2])
+            warm_step = (time.perf_counter() - t0) / 3
+            # Regroup to the guessed world.
+            t._topo_override = WorldTopology(8, 8, 1)
+            m["membership"].add_worker_host("10.0.0.2:9999")
+            compiles_before = profiling.tracker().snapshot()[0]
+            t.train_minibatch(x, y)
+            assert dict(t._mesh.shape) == {"data": 8}
+            assert profiling.tracker().snapshot()[0] == compiles_before, (
+                "regroup into the speculated world still compiled"
+            )
+            assert t._speculator.stats["consumed"] == 1
+            # Donation preserved through the AOT path: the consumed
+            # executable aliases (variables, opt_state) in place.
+            v_before = t._variables
+            import jax
+
+            jax.block_until_ready(t.train_minibatch(x, y)[2])
+            assert all(
+                a.is_deleted()
+                for a in jax.tree_util.tree_leaves(v_before)
+            ), "consumed step did not donate its state inputs"
+            # ms/step sanity: the consumed executable performs like a
+            # locally compiled one (a per-call retrace pathology would
+            # be orders of magnitude off; the bound is deliberately
+            # loose for loaded CI boxes).
+            t0 = time.perf_counter()
+            for _ in range(3):
+                jax.block_until_ready(t.train_minibatch(x, y)[2])
+            consumed_step = (time.perf_counter() - t0) / 3
+            assert consumed_step < max(25 * warm_step, 0.5), (
+                consumed_step, warm_step,
+            )
+        finally:
+            t.close()
+            mc.close()
+
+
+def test_wrong_world_guess_abandoned_cleanly():
+    """A prebuilt executable for a world that never forms is dropped on
+    the next regroup and can never be consumed."""
+    with start_master(
+        training_shards={"f": (0, 100)}, with_membership=True
+    ) as m:
+        t, mc = _trainer(m)
+        try:
+            x, y = _batch(16)
+            t._topo_override = WorldTopology(8, 8, 1)
+            t._topo_candidates = [WorldTopology(6, 6, 1)]  # wrong guess
+            t.train_minibatch(x, y)
+            assert t._speculator.drain(90)
+            assert t._speculator.stats["built"] == 1
+            # The world that actually forms is 7 devices, not 6.
+            t._topo_override = WorldTopology(7, 7, 1)
+            t._topo_candidates = []
+            m["membership"].add_worker_host("10.0.0.2:9999")
+            t.train_minibatch(x, y)
+            assert dict(t._mesh.shape) == {"data": 7}
+            assert t._speculator.prebuilt_keys() == []
+            assert t._speculator.stats["abandoned"] >= 1
+            assert t._speculator.stats["consumed"] == 0
+            # Training is undisturbed.
+            ok, _, loss = t.train_minibatch(x, y)
+            assert ok and np.isfinite(float(loss))
+        finally:
+            t.close()
+            mc.close()
+
+
+def test_world_change_mid_compile_cancels_without_blocking():
+    """cancel() during an in-flight speculative compile returns
+    immediately; the compile's result is discarded when it finishes
+    (XLA compiles cannot be interrupted), never installed."""
+    started = threading.Event()
+    release = threading.Event()
+
+    class FakeSpec:
+        def fingerprint(self):
+            return "w1"
+
+    class Step:
+        def lower(self, *a):
+            return self
+
+        def compile(self):
+            started.set()
+            release.wait(10)
+            return object()
+
+    s = SpeculativeWorldCompiler(lambda spec, n: ((n, n), Step(), ()))
+    try:
+        s.submit([FakeSpec()], 16)
+        assert started.wait(5), "speculative compile never started"
+        t0 = time.perf_counter()
+        s.cancel(keep_fingerprint="w2")  # the world moved mid-compile
+        assert time.perf_counter() - t0 < 0.5, (
+            "cancel blocked on the in-flight compile"
+        )
+        release.set()
+        assert s.drain(10)
+        assert s.take("w1", (16, 16)) is None
+        assert s.stats["abandoned"] == 1
+        assert s.prebuilt_keys() == []
+    finally:
+        release.set()
+        s.stop()
+
+
+def test_in_flight_guess_for_the_kept_world_survives_cancel():
+    """A regroup lands on the world whose compile is still in flight:
+    cancel(keep=that world) must NOT discard the finishing executable —
+    it is exactly what the next step wants."""
+    started = threading.Event()
+    release = threading.Event()
+
+    class FakeSpec:
+        def fingerprint(self):
+            return "w1"
+
+    class Step:
+        def lower(self, *a):
+            return self
+
+        def compile(self):
+            started.set()
+            release.wait(10)
+            return object()
+
+    s = SpeculativeWorldCompiler(lambda spec, n: ((n, n), Step(), ()))
+    try:
+        s.submit([FakeSpec()], 16)
+        assert started.wait(5)
+        s.cancel(keep_fingerprint="w1")  # the guess WAS right
+        release.set()
+        assert s.drain(10)
+        assert s.take("w1", (16, 16)) is not None
+        assert s.stats["built"] == 1
+    finally:
+        release.set()
+        s.stop()
+
+
+def test_compile_cache_knob_wiring(tmp_path, monkeypatch):
+    """ensure_compile_cache: unset knob -> disabled (memoized); the
+    instance manager stamps the dir into child env."""
+    from elasticdl_tpu.common import compile_cache
+
+    monkeypatch.delenv("ELASTICDL_COMPILE_CACHE_DIR", raising=False)
+    compile_cache.reset_for_tests()
+    try:
+        assert compile_cache.ensure_compile_cache() is None
+        # Memoized: setting the knob after the first check is ignored
+        # until reset (process-lifetime wiring, like jax's own config).
+        monkeypatch.setenv(
+            "ELASTICDL_COMPILE_CACHE_DIR", str(tmp_path / "cc")
+        )
+        assert compile_cache.ensure_compile_cache() is None
+    finally:
+        compile_cache.reset_for_tests()
+
+
+def test_relaunch_with_warm_cache_skips_cold_compile(tmp_path):
+    """Two incarnations of the same training process share one cache
+    dir: the first cold-compiles (a `compile` event), the second
+    rehydrates from disk (`compile_cache_hit`, no compile event for the
+    step) — the relaunched-worker rejoin path."""
+    cache = str(tmp_path / "cache")
+    code = """
+import json, os, sys
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, os.path.join({repo!r}, "tests"))
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+import test_module
+from elasticdl_tpu.observability import profiling
+from elasticdl_tpu.worker.trainer import LocalTrainer
+
+t = LocalTrainer(
+    test_module.custom_model(), test_module.loss, test_module.optimizer()
+)
+rng = np.random.default_rng(0)
+x = rng.normal(size=(16, test_module.FEATURE_DIM)).astype(np.float32)
+y = (x @ test_module.TRUE_W + test_module.TRUE_B).astype(np.float32)
+t.train_minibatch(x, y)
+recent = [
+    e for e in profiling.tracker().recent() if e["fn"] == "train_step"
+]
+print("RESULT:" + json.dumps(recent))
+""".format(repo=REPO)
+    env = dict(os.environ)
+    env["ELASTICDL_COMPILE_CACHE_DIR"] = cache
+
+    def run():
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            env=env,
+            timeout=180,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        line = [
+            ln for ln in out.stdout.splitlines()
+            if ln.startswith("RESULT:")
+        ][0]
+        return json.loads(line[len("RESULT:"):])
+
+    first = run()
+    assert first and not any(e.get("cache_hit") for e in first), first
+    second = run()
+    assert second, "second incarnation recorded no lowering at all"
+    assert all(e.get("cache_hit") for e in second), (
+        "relaunch with a warm cache still cold-compiled", second,
+    )
+    assert os.path.isdir(cache) and os.listdir(cache)

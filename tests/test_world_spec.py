@@ -1,0 +1,168 @@
+"""The unified world spec: deterministic (config, topology) -> mesh
+resolution (parallel/mesh.py). This map is the foundation recompile-free
+elasticity stands on — the regroup fast path trusts that equal
+fingerprints mean equal compiled programs, and the speculative AOT
+compiler trusts that a world it is not in resolves exactly as the
+trainer there would resolve it."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    STAGE_AXIS,
+    ZERO_AXIS,
+    AxisDemand,
+    ParallelConfig,
+    WorldTopology,
+    resolve_world_spec,
+)
+
+T8 = WorldTopology(n_devices=8, local_devices=8, n_processes=1)
+T2x4 = WorldTopology(n_devices=8, local_devices=4, n_processes=2)
+
+
+def axes(spec):
+    return dict(spec.axes)
+
+
+def test_resolution_is_deterministic_and_hashable():
+    cfg = ParallelConfig(model_parallel=2, has_param_specs=True)
+    a = resolve_world_spec(cfg, T8)
+    b = resolve_world_spec(cfg, T8)
+    assert a == b
+    assert hash(a) == hash(b)
+    assert a.fingerprint() == b.fingerprint()
+    # A different topology is a different world.
+    c = resolve_world_spec(cfg, WorldTopology(4, 4, 1))
+    assert c.fingerprint() != a.fingerprint()
+
+
+def test_pure_dp_default():
+    spec = resolve_world_spec(ParallelConfig(), T8)
+    assert axes(spec) == {DATA_AXIS: 8}
+    assert spec.notes == ()
+    assert not spec.process_grouped
+
+
+def test_tp_and_sp_compose_and_degrade_in_order():
+    cfg = ParallelConfig(
+        model_parallel=2,
+        has_param_specs=True,
+        context_parallel=2,
+        has_context_parallel_model=True,
+    )
+    spec = resolve_world_spec(cfg, T8)
+    assert axes(spec) == {DATA_AXIS: 2, MODEL_AXIS: 2, SEQ_AXIS: 2}
+    assert spec.tp == 2 and spec.sp == 2
+    # model x seq stops dividing: the SEQ axis drops FIRST, TP is kept.
+    tight = ParallelConfig(
+        model_parallel=4,
+        has_param_specs=True,
+        context_parallel=4,
+        has_context_parallel_model=True,
+    )
+    spec = resolve_world_spec(tight, T8)
+    assert axes(spec) == {DATA_AXIS: 2, MODEL_AXIS: 4}
+    assert spec.sp == 1 and spec.notes
+
+
+def test_tp_vetoes_fall_back_to_dp_with_notes():
+    # No param_specs hook: a model axis would duplicate compute.
+    spec = resolve_world_spec(ParallelConfig(model_parallel=2), T8)
+    assert axes(spec) == {DATA_AXIS: 8}
+    assert any("param_specs" in n for n in spec.notes)
+    # Indivisible width.
+    spec = resolve_world_spec(
+        ParallelConfig(model_parallel=3, has_param_specs=True), T8
+    )
+    assert axes(spec) == {DATA_AXIS: 8}
+    # The caller's live-shape veto (param_check) degrades identically.
+    spec = resolve_world_spec(
+        ParallelConfig(model_parallel=2, has_param_specs=True),
+        T8,
+        param_check=lambda mp: ["dim 0 (3) % 2 != 0"],
+    )
+    assert axes(spec) == {DATA_AXIS: 8}
+    assert any("incompatible" in n for n in spec.notes)
+
+
+def test_intra_process_invariant_multi_host():
+    # mp=8 divides the 8 global devices but not the 4 local ones: the
+    # model axis may not cross processes.
+    spec = resolve_world_spec(
+        ParallelConfig(model_parallel=8, has_param_specs=True), T2x4
+    )
+    assert axes(spec) == {DATA_AXIS: 8}
+    assert any("local devices" in n for n in spec.notes)
+    spec = resolve_world_spec(
+        ParallelConfig(model_parallel=2, has_param_specs=True), T2x4
+    )
+    assert axes(spec) == {DATA_AXIS: 4, MODEL_AXIS: 2}
+    assert spec.process_grouped
+
+
+def test_pipeline_takes_precedence_and_degrades_sequential():
+    cfg = ParallelConfig(pipeline_stages=2, has_pipeline_spec=True)
+    spec = resolve_world_spec(cfg, T8)
+    assert axes(spec) == {DATA_AXIS: 4, STAGE_AXIS: 2}
+    assert spec.pp == 2
+    bad = ParallelConfig(pipeline_stages=3, has_pipeline_spec=True)
+    spec = resolve_world_spec(bad, T8)
+    assert axes(spec) == {DATA_AXIS: 8}
+    assert any("sequentially" in n for n in spec.notes)
+
+
+def test_zero1_factors_multi_process_dp_only():
+    spec = resolve_world_spec(ParallelConfig(zero1=True), T2x4)
+    assert axes(spec) == {DATA_AXIS: 2, ZERO_AXIS: 4}
+    assert spec.zero1 and spec.process_grouped
+    # Single process: plain DP mesh (optimizer shards over "data" at
+    # placement time instead — no zero axis needed).
+    spec = resolve_world_spec(ParallelConfig(zero1=True), T8)
+    assert axes(spec) == {DATA_AXIS: 8}
+    assert not spec.zero1
+
+
+def test_sp_suspension_bit_is_respected():
+    cfg = ParallelConfig(
+        context_parallel=2,
+        has_context_parallel_model=True,
+        sp_suspended=True,
+    )
+    spec = resolve_world_spec(cfg, T8)
+    assert axes(spec) == {DATA_AXIS: 8}
+
+
+def test_axis_demand_feasibility_messages():
+    d = AxisDemand("model", 3)
+    why = d.infeasible_reason(T8)
+    assert "does not divide 8 devices" in why
+    d = AxisDemand("model", 8, intra_process=True)
+    assert "local devices" in d.infeasible_reason(T2x4)
+    assert d.infeasible_reason(T8) is None
+    # trailing product matters: 2 alone fits, 2 x trailing 4 = 8 does
+    # not fit in 4 local devices.
+    d = AxisDemand("seq", 2)
+    assert d.infeasible_reason(T2x4, trailing=4) is not None
+
+
+def test_build_mesh_subset_world():
+    """A spec for fewer devices than visible builds over the prefix —
+    how a speculated smaller world compiles on the live backend."""
+    import jax
+
+    spec = resolve_world_spec(
+        ParallelConfig(), WorldTopology(7, 7, 1)
+    )
+    mesh = spec.build_mesh()
+    assert dict(mesh.shape) == {DATA_AXIS: 7}
+    assert len(np.ravel(mesh.devices)) == 7
+    too_big = resolve_world_spec(
+        ParallelConfig(),
+        WorldTopology(len(jax.devices()) + 1, 16, 1),
+    )
+    with pytest.raises(ValueError):
+        too_big.build_mesh()

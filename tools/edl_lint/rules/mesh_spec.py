@@ -19,6 +19,15 @@ hardware, after minutes of setup. Statically the invariant is cheap:
    the resolvable axes of those specs must be a subset of the union of
    axes its mesh constructions can produce.
 
+3. **One birthplace for meshes** — mesh CONSTRUCTION (`make_mesh`,
+   `jax.sharding.Mesh`) anywhere in the runtime scope outside
+   `parallel/mesh.py` is rejected outright. The unified world spec
+   (`resolve_world_spec` + `WorldSpec.build_mesh`) is the only legal
+   way a trainer obtains a mesh; an ad-hoc construction would fork the
+   deterministic (config, topology) -> mesh map that the regroup fast
+   path and speculative AOT compilation key on, silently eroding the
+   recompile-free elasticity guarantee.
+
 Axis names only resolvable at runtime (plain parameters, lambda args)
 are skipped — the rule never guesses.
 """
@@ -40,6 +49,8 @@ _SPEC_TAILS = {"PartitionSpec", "P"}
 _MESH_TAILS = {"Mesh", "make_mesh"}
 # make_mesh()'s no-argument default builds a 1-D data mesh.
 _DEFAULT_MESH_AXES = frozenset({"data"})
+# The only module allowed to construct meshes: the world-spec API.
+_SPEC_API_SUFFIX = os.path.join("parallel", "mesh.py")
 
 
 def _spec_call(dotted):
@@ -209,6 +220,7 @@ class MeshSpecRule(Rule):
         class_mesh_axes = {}  # (rel, class) -> set of axes
         class_has_mesh = set()
         mesh_builder_methods = {}  # (rel, class, method) -> axes
+        rogue_constructions = []  # (rel, line, qualname) outside mesh.py
 
         # Pass 1: collect mesh constructions and spec literals.
         for sf in project.iter_files("elasticdl_tpu"):
@@ -224,6 +236,12 @@ class MeshSpecRule(Rule):
                     if tail in _MESH_TAILS and (
                         "mesh" in dotted.lower() or tail == "make_mesh"
                     ):
+                        if sf.rel.startswith(prefixes) and not (
+                            sf.rel.endswith(_SPEC_API_SUFFIX)
+                        ):
+                            rogue_constructions.append(
+                                (sf.rel, node.lineno, qualname)
+                            )
                         axes = axres.axes_of_mesh(node, dotted)
                         if axes is not None:
                             any_resolvable_mesh = True
@@ -284,6 +302,28 @@ class MeshSpecRule(Rule):
                                 key, set()
                             ).update(axes)
                         class_has_mesh.add(key)
+
+        # Check 3: meshes are born in parallel/mesh.py and nowhere else.
+        # Reported regardless of axis resolvability — an unresolvable
+        # rogue construction is exactly the kind that erodes the spec.
+        for rel, line, qualname in rogue_constructions:
+            yield Finding(
+                self.name,
+                rel,
+                line,
+                f"mesh constructed outside the parallel/mesh.py world-"
+                f"spec API (in {qualname}) — ad-hoc meshes fork the "
+                f"deterministic (config, topology) -> mesh map that "
+                f"recompile-free regroups and speculative AOT "
+                f"compilation key on",
+                key=f"mesh-outside-api:{qualname}",
+                fix_hint=(
+                    "resolve a WorldSpec (parallel/mesh.py "
+                    "resolve_world_spec) and build the mesh with "
+                    "spec.build_mesh(), or add the construction to the "
+                    "spec API itself"
+                ),
+            )
 
         if not any_resolvable_mesh:
             return  # nothing to check against (tiny fixture trees)
