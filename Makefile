@@ -21,9 +21,16 @@ verify: lint verify-tests
 verify-tests:
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
+# Kill orphaned edl process trees from earlier crashed runs (stale
+# master heartbeats; tools/reap_orphans.py). Pre-step of every lane
+# that launches real multi-process jobs — leftover workers squat on
+# ports and CPU and poison the measurements.
+reap:
+	-python tools/reap_orphans.py
+
 # Harness self-check: tiny shapes, CPU-safe, < 60 s, per-bench watchdog,
 # CI fields + the push serialize/wire/apply breakdown included.
-bench-smoke:
+bench-smoke: reap
 	JAX_PLATFORMS=cpu python -m elasticdl_tpu.bench --smoke
 
 # The regression gate: newest parseable BENCH_r*.json vs the previous
@@ -53,14 +60,20 @@ lint-changed:
 
 # The chaos scenario suite (real multi-process jobs with injected faults;
 # docs/ROBUSTNESS.md catalog) under a hard wall-clock cap.
-chaos:
+chaos: reap
 	set -o pipefail; timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly
 
 # The observability acceptance drill: a real 2w+2PS job with one worker
 # slowed by role-targeted chaos latency; the master's aggregator must
 # flag it (edl_job_straggler + alert event + /api/summary).
-obs:
+obs: reap
 	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/test_obs_aggregation.py -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly
+
+# The fleet-telemetry smoke: hundreds of simulated pods (elasticdl_tpu/
+# fleet) against a real master under seeded churn; asserts dispatch
+# throughput, telemetry freshness, and O(1) endpoint bookkeeping.
+fleet-smoke: reap
+	set -o pipefail; timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly
 
 native:
 	@if [ -f elasticdl_tpu/native/Makefile ]; then $(MAKE) -C elasticdl_tpu/native; else echo "native kernels not present yet"; fi
@@ -69,13 +82,14 @@ native:
 # even when an earlier one fails (one run answers "what is broken"), and
 # the single trailing CI: line is the machine-readable verdict.
 ci:
-	@lint=FAIL; tier1=FAIL; gate=FAIL; \
+	@lint=FAIL; tier1=FAIL; gate=FAIL; fleet=FAIL; \
 	set -o pipefail; lintlog=$$(mktemp); \
 	$(MAKE) --no-print-directory lint 2>&1 | tee $$lintlog && lint=ok; \
 	$(MAKE) --no-print-directory verify-tests && tier1=ok; \
+	$(MAKE) --no-print-directory fleet-smoke && fleet=ok; \
 	$(MAKE) --no-print-directory bench-gate && gate=ok; \
 	rules=$$(grep -ao 'per-rule: .*' $$lintlog | tail -1); rm -f $$lintlog; \
-	echo "CI: lint=$$lint tier1=$$tier1 bench-gate=$$gate$${rules:+ [$$rules]}"; \
-	[ "$$lint" = ok ] && [ "$$tier1" = ok ] && [ "$$gate" = ok ]
+	echo "CI: lint=$$lint tier1=$$tier1 fleet=$$fleet bench-gate=$$gate$${rules:+ [$$rules]}"; \
+	[ "$$lint" = ok ] && [ "$$tier1" = ok ] && [ "$$fleet" = ok ] && [ "$$gate" = ok ]
 
-.PHONY: proto test verify verify-tests bench-smoke bench-gate lint lint-changed chaos obs native ci
+.PHONY: proto test verify verify-tests reap bench-smoke bench-gate lint lint-changed chaos obs fleet-smoke native ci

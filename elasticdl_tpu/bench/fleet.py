@@ -1,0 +1,150 @@
+"""Fleet-scale control-plane bench: push-vs-pull A/B at N pods.
+
+Each cell runs the simulated-fleet harness (real gRPC task protocol,
+real aggregator, scripted churn) for a fixed window and measures what
+the master's control plane costs at that scale:
+
+- ``master_tick_ms``: per-poll_once wall time (summarized with CIs —
+  the pull cells pay the scrape fan-out here, the push cells only the
+  derive pass),
+- ``dispatch_per_s``: get_task+report_task_result round-trips the
+  dispatcher sustained while telemetry ran,
+- ``freshness``: the fleet telemetry-age rollup the aggregator derived,
+- ``summary_render_ms``: /api/summary over real HTTP at that roster
+  size.
+
+The A/B is same-run by construction: both modes of one size execute
+back-to-back in this process, so host noise hits both sides alike. The
+headline ``push_vs_pull`` block compares master-tick medians at the
+largest size both modes completed.
+"""
+
+import time
+
+from elasticdl_tpu.bench import stats
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger(__name__)
+
+DEFAULT_SIZES = (50, 200, 500)
+# Churn scales with the fleet: ~2% of pods die (and relaunch), ~2%
+# straggle, floors of 2 each so small cells still see both paths.
+KILL_FRACTION = 0.02
+STRAGGLER_FRACTION = 0.02
+
+
+def _run_cell(n_pods, mode, seconds, seed):
+    from elasticdl_tpu.fleet.harness import FleetHarness, churn_schedule
+
+    # Scale the window with the roster: at 500 pods one pull sweep costs
+    # seconds of master tick, so a fixed small window yields fewer tick
+    # samples than MIN_SAMPLES_FOR_CI and the A/B loses its intervals.
+    seconds = max(seconds, n_pods * 0.03)
+    kills = max(2, int(n_pods * KILL_FRACTION))
+    stragglers = max(2, int(n_pods * STRAGGLER_FRACTION))
+    n_ps = max(1, n_pods // 10)
+    schedule = churn_schedule(
+        n_pods, kills=kills, stragglers=stragglers, seed=seed
+    )
+    harness = FleetHarness(
+        n_workers=n_pods - n_ps,
+        n_ps=n_ps,
+        mode=mode,
+        tick_interval=0.25,
+        push_interval=1.0,
+        aggregator_interval=0.5,
+        schedule=schedule,
+        seed=seed,
+    )
+    t0 = time.perf_counter()
+    render_s = []
+    try:
+        harness.start()
+        harness.run(seconds)
+        # Render probes at the end, when the roster is fully populated.
+        for _ in range(5):
+            r0 = time.perf_counter()
+            harness.fetch_summary_http()
+            render_s.append(time.perf_counter() - r0)
+        run_stats = harness.stats()
+    finally:
+        harness.stop()
+    elapsed = time.perf_counter() - t0
+    counts = run_stats["counts"]
+    fleet = run_stats["fleet"]
+    tick_ms = [s * 1000.0 for s in harness.master_tick_seconds]
+    # Drop warmup ticks: the first polls land before the roster has
+    # ramped (near-empty sweeps cost microseconds), which makes the
+    # sample set bimodal and the bootstrap CI uselessly wide.
+    if len(tick_ms) > 4:
+        tick_ms = tick_ms[2:]
+    cell = {
+        "pods": n_pods,
+        "mode": mode,
+        "seconds": round(elapsed, 2),
+        "dispatch_per_s": round(
+            (counts["dispatched"] + counts["reported"]) / max(elapsed, 1e-9),
+            1,
+        ),
+        "master_tick_ms": stats.summarize(tick_ms),
+        "summary_render_ms": stats.summarize(
+            [s * 1000.0 for s in render_s]
+        ),
+        "roles_reporting": fleet.get("roles_reporting"),
+        "freshness_max_s": fleet.get("freshness_max_s"),
+        "freshness_p99_s": fleet.get("freshness_p99_s"),
+        "kills": counts["kills"],
+        "relaunches": counts["relaunches"],
+        "rpc_errors": counts["rpc_errors"],
+    }
+    if mode == "push":
+        cell["pushes"] = counts["pushes"]
+        cell["push_batches"] = counts["push_batches"]
+        cell["need_full"] = counts["need_full"]
+    return cell
+
+
+def bench_fleet(sizes=DEFAULT_SIZES, seconds=6.0, seed=0, clock=None):
+    """All cells; returns {"cells": {...}, "push_vs_pull": {...}}.
+
+    A spent budget clock skips remaining cells (recorded, per the bench
+    truncation-is-visible rule) — sizes run smallest first so the cheap
+    cells survive a tight budget and the A/B block degrades to the
+    largest size that finished both modes."""
+    cells = {}
+    completed_both = []
+    for n in sizes:
+        for mode in ("push", "pull"):
+            key = f"n{n}_{mode}"
+            if clock is not None and clock.expired:
+                cells[key] = {"skipped": "budget"}
+                continue
+            logger.info("fleet bench cell %s starting", key)
+            cells[key] = _run_cell(n, mode, seconds, seed)
+        if all(
+            "skipped" not in cells[f"n{n}_{m}"] for m in ("push", "pull")
+        ):
+            completed_both.append(n)
+    out = {"cells": cells}
+    if completed_both:
+        n = max(completed_both)
+        push = cells[f"n{n}_push"]["master_tick_ms"]
+        pull = cells[f"n{n}_pull"]["master_tick_ms"]
+        push_ci = push.get("ci95")
+        pull_ci = pull.get("ci95")
+        out["push_vs_pull"] = {
+            "pods": n,
+            "push_tick_ms_median": push.get("median"),
+            "pull_tick_ms_median": pull.get("median"),
+            "pull_over_push": (
+                round(pull["median"] / push["median"], 2)
+                if push.get("median")
+                else None
+            ),
+            # Strongest claim the samples support: the CIs themselves
+            # are disjoint, not just the medians ordered.
+            "ci_separated": bool(
+                push_ci and pull_ci and push_ci[1] < pull_ci[0]
+            ),
+        }
+    return out

@@ -149,6 +149,7 @@ def run_full(watchdog_s=None, budget_s=None, with_matrix=True,
     """The full suite. Returns the process exit code."""
     import jax  # the full suite is meaningless without a backend
 
+    from elasticdl_tpu.bench import fleet as fleet_bench
     from elasticdl_tpu.bench import matrix, workloads
 
     if watchdog_s is None:
@@ -188,6 +189,16 @@ def run_full(watchdog_s=None, budget_s=None, with_matrix=True,
             "deepfm_ps_mode", "deepfm_ps",
             lambda: workloads.bench_deepfm_ps(clock=clock),
             watchdog_s, False,
+        ),
+        # Fleet cells run EARLY: jax-free (simulated pods, real control
+        # plane), ~2-3 min total, and they must not be squeezed by
+        # whatever budget the matrix/rejoin leave over — a mid-A/B
+        # watchdog kill discards both sides of the comparison. Still a
+        # many-part bench, so the floored watchdog applies.
+        (
+            "fleet", "fleet",
+            lambda: fleet_bench.bench_fleet(clock=clock),
+            watchdog_s and max(watchdog_s, 600), False,
         ),
     ]
     if with_matrix:

@@ -346,7 +346,9 @@ def _dash(args):
                 )
             except (OSError, ValueError):
                 summary = {}  # aggregator still warming up
-        frame = dashboard.render(summary, status)
+        frame = dashboard.render(
+            summary, status, top=getattr(args, "top", 0)
+        )
         if args.once:
             print(frame, flush=True)
             return 1 if status.job_failed else 0
@@ -629,6 +631,13 @@ def main(argv=None):
             type=int,
             default=0,
             help="stop after N frames (0 = until the job ends)",
+        )
+        dash.add_argument(
+            "--top",
+            type=int,
+            default=10,
+            help="cap worker/PS sections to the K worst rows "
+            "(slowest workers, busiest shards); 0 shows every row",
         )
         return _dash(dash.parse_args(rest))
 

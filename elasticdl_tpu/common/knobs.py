@@ -204,6 +204,41 @@ declare("ELASTICDL_PEAK_FLOPS", "float", 0.0,
         "Per-device peak FLOP/s override for MFU; 0 falls back to the "
         "device-kind table.")
 
+# -- push-based telemetry (observability/push.py, aggregator) --
+declare("ELASTICDL_TELEMETRY_PUSH_INTERVAL", "float", 0.0,
+        "Seconds between push-telemetry reports from workers/PS to the "
+        "master's ReportTelemetry RPC; 0 (default) disables pushing and "
+        "leaves the master's pull-scrape loop as the only path. A "
+        "pushing role is skipped by the pull loop while its pushes stay "
+        "fresh (pull remains the fallback).")
+declare("ELASTICDL_TELEMETRY_PUSH_JITTER", "float", 0.2,
+        "Fractional jitter applied to each push interval so a fleet of "
+        "reporters does not dogpile the master in lockstep.")
+declare("ELASTICDL_TELEMETRY_FULL_EVERY", "int", 16,
+        "Every Nth telemetry push is a full snapshot instead of a delta "
+        "(bounded resync horizon after a lost/reordered push); 0 sends "
+        "a full snapshot only when the master asks (need_full).")
+
+# -- event-log coalescing (observability/events.py) --
+declare("ELASTICDL_EVENT_COALESCE_SECONDS", "float", 0.0,
+        "Coalescing window for high-frequency event kinds: after one "
+        "event of a coalesced kind is written, further events of that "
+        "kind within the window are folded into the next write (which "
+        "carries a coalesced=N field) instead of each taking a line. "
+        "0 (default) writes every event.")
+declare("ELASTICDL_EVENT_COALESCE_KINDS", "str", "membership_epoch",
+        "Comma-separated event kinds subject to the coalescing window "
+        "(per-epoch membership churn is the canonical spammer).")
+
+# -- master heartbeat / orphan reaper (master/, tools/reap_orphans.py) --
+declare("ELASTICDL_HEARTBEAT_DIR", "str", "/tmp/elasticdl_heartbeats",
+        "Directory where each master writes its <job>-<pid>.json "
+        "heartbeat (pid, pgid, ts); tools/reap_orphans.py kills process "
+        "groups whose heartbeat went stale (SIGKILLed drivers strand "
+        "whole `edl train` trees). Empty disables the heartbeat.")
+declare("ELASTICDL_HEARTBEAT_SECONDS", "float", 10.0,
+        "Master heartbeat touch period in seconds; 0 disables.")
+
 # -- alert rules (observability/alerts.py) --
 declare("ELASTICDL_ALERT_STRAGGLER_SKEW", "float", 2.0,
         "Straggler alert threshold: worker EWMA step latency over fleet "

@@ -110,6 +110,10 @@ MASTER_SERVICE = ServiceSpec(
         "report_worker_liveness": (pb.ReportWorkerLivenessRequest, pb.Empty),
         "get_job_status": (pb.GetJobStatusRequest, pb.JobStatusResponse),
         "start_profile": (pb.StartProfileRequest, pb.StartProfileResponse),
+        "report_telemetry": (
+            pb.ReportTelemetryRequest,
+            pb.ReportTelemetryResponse,
+        ),
     },
 )
 
@@ -199,6 +203,17 @@ METHOD_POLICIES = {
     "report_lease": RetryPolicy(deadline=30.0),
     "report_worker_liveness": RetryPolicy(deadline=30.0),
     "get_job_status": RetryPolicy(deadline=15.0),
+    # Telemetry pushes are periodic and self-healing (a lost snapshot is
+    # resent as a full resync on the next interval), so a failed push is
+    # never worth burning retry budget on: one connectivity retry, and a
+    # timed-out push — which may have applied and bumped the seq server
+    # side — must NOT replay (the replayed seq would read as a gap and
+    # force a spurious full resync).
+    "report_telemetry": RetryPolicy(
+        deadline=15.0,
+        max_attempts=2,
+        retryable_codes=_RETRYABLE_CONNECTIVITY,
+    ),
     # Profile fan-out blocks for the capture duration on every role; not
     # idempotent (each attempt burns a capture slot on every endpoint),
     # so a timed-out request is never replayed and connectivity failures

@@ -1,0 +1,30 @@
+"""Simulated-fleet harness: hundreds of lightweight pods from one process.
+
+Real 500-pod jobs don't fit CI, but the master's control plane must be
+measured at that scale — task-dispatch latency, scrape fan-out cost,
+telemetry freshness, endpoint bookkeeping. This package fakes the POD
+(no jax, no training, a few hundred bytes of state each) while keeping
+every PROTOCOL real: simulated workers pull tasks and report results
+over actual gRPC against a real TaskDispatcher + MasterServicer,
+publish real MetricsRegistry families, and either expose genuine
+/metrics HTTP endpoints with endpoint-advert files (pull mode) or push
+delta-encoded snapshots through a relay tree into the ReportTelemetry
+RPC (push mode). Churn — kill/leave/rejoin, stragglers — is scripted
+through the existing chaos FaultSchedule so runs replay exactly.
+
+    from elasticdl_tpu.fleet import FleetHarness
+    h = FleetHarness(n_workers=200, n_ps=20, mode="push")
+    h.start(); h.run(10.0); stats = h.stats(); h.stop()
+
+`python -m elasticdl_tpu.fleet --pods 200 --seconds 10` runs one from
+the command line and prints the stats dict.
+"""
+
+from elasticdl_tpu.fleet.harness import (  # noqa: F401
+    FleetHarness,
+    FleetMaster,
+    Relay,
+    SimPod,
+    build_relay_chain,
+    churn_schedule,
+)

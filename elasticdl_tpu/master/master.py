@@ -363,6 +363,12 @@ class Master:
             self.servicer, rpc.MASTER_SERVICE, port=self.args.master_port
         )
         logger.info("Master serving on port %d", self.port)
+        # Orphan-reaper beacon: while this file stays fresh the job's
+        # process group is alive on purpose; once it goes stale,
+        # tools/reap_orphans.py may SIGKILL the whole group.
+        from elasticdl_tpu.common.heartbeat import HeartbeatWriter
+
+        self._heartbeat = HeartbeatWriter(job=self.args.job_name).start()
         if self.obs.metrics_port:
             logger.info(
                 "Prometheus metrics on :%d/metrics", self.obs.metrics_port
@@ -522,6 +528,10 @@ class Master:
                 self.membership.remove_worker(worker_id)
 
     def stop(self):
+        heartbeat = getattr(self, "_heartbeat", None)
+        if heartbeat is not None:
+            heartbeat.close()
+            self._heartbeat = None
         if self.aggregator is not None:
             self.aggregator.close()
             self.aggregator = None

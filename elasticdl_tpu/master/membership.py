@@ -11,6 +11,7 @@ positions in the time-sorted host list, so they are stable for survivors.
 """
 
 import threading
+import time
 
 from elasticdl_tpu.common.constants import (
     COORDINATOR_PORT_ROTATION as PORT_ROTATION,
@@ -26,6 +27,17 @@ _EPOCH = default_registry().gauge(
 )
 _WORLD = default_registry().gauge(
     "edl_membership_world_size", "Workers in the current comm group"
+)
+# Epoch-bookkeeping cost (gauge updates + event emission, under the
+# membership lock): at fleet churn rates this is per-event control-plane
+# work the master must keep sub-millisecond.
+_EPOCH_SECONDS = default_registry().histogram(
+    "edl_master_membership_update_seconds",
+    "Time spent on membership-epoch bookkeeping per epoch change",
+    buckets=(
+        0.00001, 0.00005, 0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05,
+        0.1, 0.5, 1.0,
+    ),
 )
 
 
@@ -55,6 +67,7 @@ class MembershipManager:
             return self._group_id
 
     def _epoch_changed_locked(self, cause):
+        t0 = time.perf_counter()
         _EPOCH.set(self._group_id)
         _WORLD.set(len(self._hosts))
         emit_event(
@@ -63,6 +76,7 @@ class MembershipManager:
             world=len(self._hosts),
             cause=cause,
         )
+        _EPOCH_SECONDS.observe(time.perf_counter() - t0)
 
     def add_worker_host(self, host):
         with self._lock:

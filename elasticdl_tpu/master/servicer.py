@@ -232,6 +232,27 @@ class MasterServicer:
             self._membership.register(request.worker_id, request.host)
         return pb.Empty()
 
+    def report_telemetry(self, request, context):
+        """Push-based telemetry: merge a batch of (delta-encoded) metric
+        snapshots into the aggregator. Roles the aggregator cannot
+        extend (sequence gap) come back in need_full, telling the
+        reporter to resend a full snapshot. Without a bound aggregator
+        every snapshot lands on need_full — the reporter keeps resending
+        fulls, so binding late loses nothing but compression."""
+        if self._aggregator is None:
+            return pb.ReportTelemetryResponse(
+                accepted=0,
+                need_full=sorted(
+                    {s.role for s in request.snapshots if s.role}
+                ),
+            )
+        accepted, need_full = self._aggregator.ingest_push(
+            request.snapshots, origin=request.origin
+        )
+        return pb.ReportTelemetryResponse(
+            accepted=accepted, need_full=need_full
+        )
+
     def start_profile(self, request, context):
         """Fan an on-demand device-profile capture out to every
         advertised endpoint (each role's /debug/profile HTTP endpoint),

@@ -36,6 +36,7 @@ from elasticdl_tpu.common import knobs
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.observability import alerts as alerts_mod
 from elasticdl_tpu.observability import promtext
+from elasticdl_tpu.observability import push as push_mod
 from elasticdl_tpu.observability.metrics import default_registry
 
 logger = get_logger("observability.aggregator")
@@ -79,6 +80,14 @@ def read_endpoints(endpoints_dir):
         if info.get("port"):
             endpoints.append(info)
     return endpoints
+
+
+def _snap_field(snap, name, default):
+    """Field access across pb.TelemetrySnapshot / dict / namespace —
+    ingest_push accepts all three (tests and relays skip the proto)."""
+    if isinstance(snap, dict):
+        return snap.get(name, default)
+    return getattr(snap, name, default)
 
 
 class SeriesStore:
@@ -154,6 +163,16 @@ class SeriesStore:
             for (s_role, s_name, labels) in list(self._series)
             if s_role == role and s_name == name
         ]
+
+
+def percentile(sorted_values, q):
+    """Nearest-rank percentile of an ascending list; None when empty.
+    (The histogram-bucket estimator above is for cumulative buckets;
+    this one is for plain value lists — fleet rollups.)"""
+    if not sorted_values:
+        return None
+    rank = math.ceil(q * len(sorted_values)) - 1
+    return sorted_values[min(len(sorted_values) - 1, max(0, rank))]
 
 
 def skew_scores(values, min_subjects=2):
@@ -241,6 +260,21 @@ class TelemetryAggregator:
         self._throughput_history = collections.deque(maxlen=60)
         self._stop = threading.Event()
         self._thread = None
+        # Store/derive mutations happen from the poll thread AND from
+        # gRPC handler threads (ingest_push); one lock covers both.
+        self._ingest_lock = threading.RLock()
+        # (role, pid) -> {"seq", "families", "ts"}: per-origin merged
+        # push state. A delta only applies when it extends the held seq.
+        self._push_states = {}
+        self._push_last_by_role = {}  # role -> last accepted push ts
+        # role -> ts of the last ingested payload (push or pull); the
+        # telemetry-freshness signal.
+        self._last_report = {}
+        # Endpoint directory cache: rescan only when the dir mtime moved
+        # (advert add/withdraw/rewrite touches the parent dir) — O(1)
+        # steady-state instead of a listdir+parse of N files per pass.
+        self._ep_cache = []
+        self._ep_sig = None
 
         reg = self._registry
         self._g_rps = reg.gauge(
@@ -316,6 +350,61 @@ class TelemetryAggregator:
             "Seconds spent compiling tracked step functions, summed "
             "across all scraped roles",
         )
+        # Control-plane self-instrumentation (edl_master_*): the master
+        # is itself a first-class telemetry subject at fleet scale.
+        self._h_fanout = reg.histogram(
+            "edl_master_scrape_fanout_seconds",
+            "Wall time of the pull-scrape fan-out portion of one "
+            "aggregation pass",
+        )
+        self._h_tick = reg.histogram(
+            "edl_master_aggregation_tick_seconds",
+            "Wall time of one full aggregation pass (scrape + ingest + "
+            "derive)",
+        )
+        self._c_ep_rescans = reg.counter(
+            "edl_master_endpoint_rescans_total",
+            "Endpoint-directory rescans (bounded by membership events, "
+            "not by aggregation passes)",
+        )
+        self._c_ep_diffs = reg.counter(
+            "edl_master_endpoint_diffs_total",
+            "Endpoint membership diffs observed on rescan",
+            labelnames=("op",),
+        )
+        self._c_push_reports = reg.counter(
+            "edl_master_push_reports_total",
+            "ReportTelemetry batches handled",
+        )
+        self._c_push_snapshots = reg.counter(
+            "edl_master_push_snapshots_total",
+            "Pushed telemetry snapshots accepted, by encoding",
+            labelnames=("kind",),
+        )
+        self._c_push_bytes = reg.counter(
+            "edl_master_push_payload_bytes_total",
+            "Pushed telemetry payload volume",
+        )
+        self._c_push_resyncs = reg.counter(
+            "edl_master_push_resyncs_total",
+            "Pushed deltas rejected for a sequence gap (need_full "
+            "answered)",
+        )
+        self._g_push_roles = reg.gauge(
+            "edl_master_push_roles",
+            "Roles whose telemetry arrived by push within the freshness "
+            "horizon",
+        )
+        self._g_freshness = reg.gauge(
+            "edl_master_telemetry_freshness_seconds",
+            "Age of the stalest reporting role's telemetry at the end "
+            "of the last pass",
+        )
+        self._h_staleness = reg.histogram(
+            "edl_master_telemetry_staleness_seconds",
+            "Per-role telemetry age observed each pass",
+            buckets=(0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 15.0, 30.0, 60.0),
+        )
 
     # ---------- lifecycle ----------
 
@@ -371,23 +460,69 @@ class TelemetryAggregator:
             .decode()
         )
 
+    def _refresh_endpoints(self):
+        """Cached advertisement list, rescanned only when the endpoints
+        directory's mtime says an advert landed, was rewritten, or was
+        withdrawn (os.replace/unlink bump the parent dir's mtime) —
+        O(1) per pass steady-state, one rescan per membership event.
+        The counters below make that claim test-assertable."""
+        try:
+            st = os.stat(self._endpoints_dir)
+            sig = st.st_mtime_ns
+        except OSError:
+            self._ep_cache = []
+            self._ep_sig = None
+            return self._ep_cache
+        # While the dir mtime sits inside the last second, keep
+        # rescanning: coarse-mtime filesystems and a write landing in
+        # the same tick would otherwise be invisible.
+        if sig == self._ep_sig and (time.time() - st.st_mtime) > 1.0:
+            return self._ep_cache
+        before = {self._endpoint_key(i) for i in self._ep_cache}
+        self._ep_cache = read_endpoints(self._endpoints_dir)
+        self._ep_sig = sig
+        self._c_ep_rescans.inc()
+        after = {self._endpoint_key(i) for i in self._ep_cache}
+        for _ in after - before:
+            self._c_ep_diffs.labels(op="add").inc()
+        for _ in before - after:
+            self._c_ep_diffs.labels(op="withdraw").inc()
+        return self._ep_cache
+
+    def _push_horizon(self):
+        """How recently a role must have pushed for the pull loop to
+        leave it alone (and for it to count as push-reporting)."""
+        return 3.0 * self.interval
+
+    def _push_fresh(self, role, now):
+        ts = self._push_last_by_role.get(role)
+        return ts is not None and (now - ts) <= self._push_horizon()
+
     def poll_once(self, now=None):
         """One scrape + derive + export pass (the thread's body; callable
         directly from tests and `edl dash --once` style flows). Without
         an explicit `now`, each endpoint's samples are stamped when they
         were actually read — endpoints scrape sequentially with a
         per-endpoint timeout, and a wedged peer must not skew the rate
-        denominators of everyone scraped after it."""
+        denominators of everyone scraped after it. Roles with a fresh
+        push are skipped here: push owns their freshness, pull stays the
+        fallback when pushes stop."""
+        t_tick = time.perf_counter()
         live = now is None
         scraped = set()
         stale = 0
         live_keys = set()
-        for info in read_endpoints(self._endpoints_dir):
+        texts = []  # (role, text, ts) — ingested under the lock below
+        wall = time.time() if live else now
+        t_fanout = time.perf_counter()
+        for info in self._refresh_endpoints():
             role = info.get("role", "")
             if role == "master" and info.get("pid") == os.getpid():
                 continue  # own registry is read in-process below
             key = self._endpoint_key(info)
             live_keys.add(key)
+            if self._push_fresh(role, wall):
+                continue
             if self._is_stale(info):
                 # Dead pod whose advertisement survived (SIGKILL skips
                 # the clean-shutdown removal): stop hammering the port.
@@ -411,24 +546,32 @@ class TelemetryAggregator:
                     )
                 continue
             self._scrape_failures.pop(key, None)
-            ts = time.time() if live else now
-            if self._ingest(role, text, ts):
-                scraped.add(role)
-                self._c_scrapes.labels(role=role or "?").inc()
+            texts.append((role, text, time.time() if live else now))
+        self._h_fanout.observe(time.perf_counter() - t_fanout)
         # Forget failure counts of withdrawn/rewritten advertisements so
         # the map stays bounded by the live endpoint set.
         for key in list(self._scrape_failures):
             if key not in live_keys:
                 del self._scrape_failures[key]
         self._g_stale.set(stale)
-        # The master's own registry never travels over HTTP: reading it
-        # in-process keeps master-side signals alive even when its
-        # exporter could not bind a port.
         now = time.time() if live else now
-        if self._ingest("master", self._registry.expose(), now):
-            scraped.add("master")
-            self._c_scrapes.labels(role="master").inc()
-        self._derive(now, scraped)
+        with self._ingest_lock:
+            for role, text, ts in texts:
+                if self._ingest(role, text, ts):
+                    scraped.add(role)
+                    self._c_scrapes.labels(role=role or "?").inc()
+            # Push-reporting roles are as good as scraped for derive.
+            for role, ts in self._push_last_by_role.items():
+                if (now - ts) <= self._push_horizon():
+                    scraped.add(role)
+            # The master's own registry never travels over HTTP:
+            # reading it in-process keeps master-side signals alive
+            # even when its exporter could not bind a port.
+            if self._ingest("master", self._registry.expose(), now):
+                scraped.add("master")
+                self._c_scrapes.labels(role="master").inc()
+            self._derive(now, scraped)
+        self._h_tick.observe(time.perf_counter() - t_tick)
 
     def _ingest(self, role, text, now):
         """Parse + store one payload; False (and a scrape-error count)
@@ -439,6 +582,12 @@ class TelemetryAggregator:
         except promtext.ParseError:
             self._c_scrape_errors.labels(role=role or "?").inc()
             return False
+        self._ingest_families(role, families, now)
+        return True
+
+    def _ingest_families(self, role, families, now):
+        """Store every sample of already-parsed families (the push path
+        lands here directly — merged state needs no text round-trip)."""
         for family in families.values():
             # The aggregator's own edl_job_* output must not feed back
             # into its input when it ingests the master registry.
@@ -448,7 +597,65 @@ class TelemetryAggregator:
                 self.store.add(
                     role, sample.name, sample.labels, sample.value, now
                 )
-        return True
+        if role != "master":
+            self._last_report[role] = now
+
+    # ---------- push ingestion ----------
+
+    def ingest_push(self, snapshots, origin="", now=None):
+        """Apply one ReportTelemetry batch; -> (accepted, need_full).
+
+        Each snapshot is a pb.TelemetrySnapshot (or any object/dict with
+        the same fields). Fulls replace the per-(role, pid) state;
+        deltas must extend the held sequence (seq == last+1) or the
+        role lands on the need_full list and the reporter resends a
+        full snapshot next push. The merged state — not the delta — is
+        ingested each time, so the series store ends up exactly where a
+        pull scrape of the same registry would have put it."""
+        wall = time.time() if now is None else now
+        accepted = 0
+        need_full = set()
+        self._c_push_reports.inc()
+        with self._ingest_lock:
+            for snap in snapshots:
+                role = _snap_field(snap, "role", "")
+                pid = _snap_field(snap, "pid", 0)
+                seq = _snap_field(snap, "seq", 0)
+                full = _snap_field(snap, "full", False)
+                payload = _snap_field(snap, "payload", "")
+                key = (role, pid)
+                self._c_push_bytes.inc(len(payload))
+                try:
+                    delta = (
+                        promtext.parse(payload)
+                        if payload
+                        else collections.OrderedDict()
+                    )
+                except promtext.ParseError:
+                    self._c_scrape_errors.labels(role=role or "?").inc()
+                    need_full.add(role)
+                    continue
+                state = self._push_states.get(key)
+                if full:
+                    state = {"seq": seq, "families": delta, "ts": wall}
+                    self._push_states[key] = state
+                    self._c_push_snapshots.labels(kind="full").inc()
+                elif state is None or seq != state["seq"] + 1:
+                    # Lost/reordered push (or a master restart): the
+                    # held state no longer matches what the reporter
+                    # diffed against.
+                    self._c_push_resyncs.inc()
+                    need_full.add(role)
+                    continue
+                else:
+                    push_mod.apply_delta(state["families"], delta)
+                    state["seq"] = seq
+                    state["ts"] = wall
+                    self._c_push_snapshots.labels(kind="delta").inc()
+                self._ingest_families(role, state["families"], wall)
+                self._push_last_by_role[role] = wall
+                accepted += 1
+        return accepted, sorted(need_full)
 
     # ---------- derivation ----------
 
@@ -654,6 +861,59 @@ class TelemetryAggregator:
         self._gauged_workers |= set(step_means)
         self._g_workers.set(len(workers))
 
+        # --- telemetry freshness + fleet rollups ---
+        # Per-role age of the last ingested payload (push or pull).
+        # Roles silent for 30 intervals are dead/scaled away and leave
+        # the freshness sample set (their series age out via rate()'s
+        # staleness window already).
+        freshness = {}
+        for role, ts in list(self._last_report.items()):
+            age = now - ts
+            if age > 30.0 * self.interval:
+                del self._last_report[role]
+                continue
+            freshness[role] = age
+            self._h_staleness.observe(max(0.0, age))
+        # _derive always runs under _ingest_lock (re-entrant), but take
+        # it explicitly here: these maps are also written by the gRPC
+        # handler path and the pruning must visibly share that guard.
+        with self._ingest_lock:
+            for key, state in list(self._push_states.items()):
+                if now - state["ts"] > 30.0 * self.interval:
+                    del self._push_states[key]
+            for role, ts in list(self._push_last_by_role.items()):
+                if now - ts > 30.0 * self.interval:
+                    del self._push_last_by_role[role]
+        push_roles = sum(
+            1
+            for ts in self._push_last_by_role.values()
+            if (now - ts) <= self._push_horizon()
+        )
+        self._g_push_roles.set(push_roles)
+        ages = sorted(freshness.values())
+        fresh_max = ages[-1] if ages else None
+        if fresh_max is not None:
+            self._g_freshness.set(fresh_max)
+        step_vals = sorted(step_means.values())
+        fleet = {
+            "workers_reporting": len(workers),
+            "ps_reporting": len(ps),
+            "roles_reporting": len(freshness),
+            "push_roles": push_roles,
+            "pull_roles": max(0, len(freshness) - push_roles),
+            "step_ewma_p50": percentile(step_vals, 0.50),
+            "step_ewma_p90": percentile(step_vals, 0.90),
+            "step_ewma_p99": percentile(step_vals, 0.99),
+            "freshness_max_s": (
+                None if fresh_max is None else round(fresh_max, 3)
+            ),
+            "freshness_p99_s": (
+                None
+                if not ages
+                else round(percentile(ages, 0.99), 3)
+            ),
+        }
+
         membership_epoch = self.store.latest(
             "master", "edl_membership_epoch"
         )
@@ -680,6 +940,7 @@ class TelemetryAggregator:
             "alerts_fired": self.engine.fired_total,
             "membership_epoch": membership_epoch,
             "roles_scraped": sorted(scraped),
+            "fleet": fleet,
             "compiles": {
                 "total": sum(compile_counts.values()),
                 "by_cause": compile_counts,

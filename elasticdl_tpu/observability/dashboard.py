@@ -71,8 +71,13 @@ def _fmt_rate(v, unit=""):
     return f"{v:.1f}{unit}"
 
 
-def render(summary, status=None, width=None):
-    """One dashboard frame as a string (no trailing clear codes)."""
+def render(summary, status=None, width=None, top=0):
+    """One dashboard frame as a string (no trailing clear codes).
+
+    `top` caps the per-worker and per-PS sections to the K worst rows
+    (slowest workers, busiest shards) — at 300+ pods a full roster is
+    unreadable and the fleet rollup line carries the rest. 0 shows
+    everything (the historical behavior)."""
     if width is None:
         width = shutil.get_terminal_size((100, 24)).columns
     width = max(60, width)
@@ -110,14 +115,43 @@ def render(summary, status=None, width=None):
         f"abandoned={_int(tasks.get('abandoned'))}"
     )
 
+    fleet = summary.get("fleet") or {}
+    if fleet.get("roles_reporting"):
+        lines.append(
+            f"fleet roles={_int(fleet.get('roles_reporting'))} "
+            f"(push={_int(fleet.get('push_roles'))} "
+            f"pull={_int(fleet.get('pull_roles'))})  "
+            f"step p50/p90/p99="
+            f"{_fmt_seconds(fleet.get('step_ewma_p50'))}/"
+            f"{_fmt_seconds(fleet.get('step_ewma_p90'))}/"
+            f"{_fmt_seconds(fleet.get('step_ewma_p99'))}  "
+            f"telemetry age max={_fmt_seconds(fleet.get('freshness_max_s'))} "
+            f"p99={_fmt_seconds(fleet.get('freshness_p99_s'))}"
+        )
+
     workers = summary.get("workers") or {}
     if workers:
         lines.append("")
-        lines.append("worker step time (ewma)")
+        shown = sorted(workers)
+        if top and len(workers) > top:
+            # Slowest-first: at fleet scale the interesting rows are
+            # the stragglers; the fleet line above covers the healthy
+            # majority.
+            shown = sorted(
+                workers,
+                key=lambda r: workers[r].get("ewma") or 0,
+                reverse=True,
+            )[:top]
+            lines.append(
+                f"worker step time (ewma) — slowest {top} of "
+                f"{len(workers)}"
+            )
+        else:
+            lines.append("worker step time (ewma)")
         scale = max(
             (w.get("ewma") or 0) for w in workers.values()
         ) or None
-        for role in sorted(workers):
+        for role in shown:
             w = workers[role]
             ewma = w.get("ewma")
             flags = ""
@@ -137,14 +171,24 @@ def render(summary, status=None, width=None):
     ps = summary.get("ps") or {}
     if ps:
         lines.append("")
-        lines.append("ps shard load (push+pull bytes/s)")
         totals = {
             role: (s.get("push_bytes_per_second") or 0)
             + (s.get("pull_bytes_per_second") or 0)
             for role, s in ps.items()
         }
+        shown = sorted(ps)
+        if top and len(ps) > top:
+            shown = sorted(
+                ps, key=lambda r: totals[r], reverse=True
+            )[:top]
+            lines.append(
+                f"ps shard load (push+pull bytes/s) — busiest {top} "
+                f"of {len(ps)}"
+            )
+        else:
+            lines.append("ps shard load (push+pull bytes/s)")
         scale = max(totals.values()) or None
-        for role in sorted(ps):
+        for role in shown:
             s = ps[role]
             ratio = s.get("load_ratio")
             ratio_txt = f"  x{ratio}" if ratio is not None else ""

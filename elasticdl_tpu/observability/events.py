@@ -23,16 +23,54 @@ import json
 import threading
 import time
 
+from elasticdl_tpu.common import knobs
+from elasticdl_tpu.observability.metrics import default_registry
 from elasticdl_tpu.observability.rotation import SizeCappedFile
+
+COALESCE_SECONDS_ENV = "ELASTICDL_EVENT_COALESCE_SECONDS"
+COALESCE_KINDS_ENV = "ELASTICDL_EVENT_COALESCE_KINDS"
 
 
 class EventLog:
-    def __init__(self, path, job="", role="", max_bytes=None):
+    def __init__(self, path, job="", role="", max_bytes=None,
+                 coalesce_seconds=None, coalesce_kinds=None):
         self.path = path
         self._job = job
         self._role = role
         self._lock = threading.Lock()
         self._seq = 0
+        # Coalescing window for high-frequency kinds (500-pod churn makes
+        # membership_epoch a write-amplification hazard): the first event
+        # of a windowed kind writes immediately; later ones inside the
+        # window fold into the NEXT write, which carries coalesced=N and
+        # the latest fields. Trailing loss — a suppressed event with no
+        # successor before close — is accepted and bounded to one window.
+        if coalesce_seconds is None:
+            coalesce_seconds = knobs.get_float(COALESCE_SECONDS_ENV)
+        if coalesce_kinds is None:
+            coalesce_kinds = knobs.get_str(COALESCE_KINDS_ENV)
+        if isinstance(coalesce_kinds, str):
+            coalesce_kinds = {
+                k.strip() for k in coalesce_kinds.split(",") if k.strip()
+            }
+        self._coalesce_seconds = max(0.0, float(coalesce_seconds))
+        self._coalesce_kinds = frozenset(coalesce_kinds)
+        self._coalesce_state = {}  # kind -> {"last_write", "suppressed"}
+        reg = default_registry()
+        self._c_written = reg.counter(
+            "edl_events_written_total",
+            "Event-log records actually written (rotation markers "
+            "included)",
+        )
+        self._c_bytes = reg.counter(
+            "edl_events_bytes_total",
+            "Bytes appended to events.jsonl",
+        )
+        self._c_suppressed = reg.counter(
+            "edl_events_suppressed_total",
+            "Events folded into a later record by the coalescing window",
+            labelnames=("kind",),
+        )
         # Size-capped: the previous generation survives as <path>.1 and
         # every fresh generation opens with a `rotated` marker event so
         # readers see a deliberate cut, not a gap.
@@ -44,21 +82,23 @@ class EventLog:
         # Called under self._lock, mid-write, right after the rename:
         # this marker is the new file's first record.
         self._seq += 1
-        self._file.append_line(
-            json.dumps(
-                {
-                    "ts": time.time(),
-                    "kind": "rotated",
-                    "role": self._role,
-                    "generation": generation,
-                    "seq": self._seq,
-                },
-                separators=(",", ":"),
-            )
+        line = json.dumps(
+            {
+                "ts": time.time(),
+                "kind": "rotated",
+                "role": self._role,
+                "generation": generation,
+                "seq": self._seq,
+            },
+            separators=(",", ":"),
         )
+        self._file.append_line(line)
+        self._c_written.inc()
+        self._c_bytes.inc(len(line) + 1)
 
     def emit(self, kind, **fields):
-        record = {"ts": time.time(), "kind": kind}
+        now = time.time()
+        record = {"ts": now, "kind": kind}
         if self._job:
             record["job"] = self._job
         if self._role:
@@ -67,6 +107,18 @@ class EventLog:
         with self._lock:
             if self._file.closed:
                 return
+            if self._coalesce_seconds and kind in self._coalesce_kinds:
+                state = self._coalesce_state.setdefault(
+                    kind, {"last_write": 0.0, "suppressed": 0}
+                )
+                if now - state["last_write"] < self._coalesce_seconds:
+                    state["suppressed"] += 1
+                    self._c_suppressed.labels(kind=kind).inc()
+                    return
+                if state["suppressed"]:
+                    record["coalesced"] = state["suppressed"]
+                    state["suppressed"] = 0
+                state["last_write"] = now
             # Rotation check BEFORE assigning seq: a rotation writes the
             # marker (which takes the next seq) as the new generation's
             # first record, so seq stays monotonic in file order. The
@@ -75,9 +127,10 @@ class EventLog:
             self._file.maybe_rotate(len(probe) + 24)
             self._seq += 1
             record["seq"] = self._seq
-            self._file.append_line(
-                json.dumps(record, separators=(",", ":"))
-            )
+            line = json.dumps(record, separators=(",", ":"))
+            self._file.append_line(line)
+            self._c_written.inc()
+            self._c_bytes.inc(len(line) + 1)
 
     def close(self):
         with self._lock:

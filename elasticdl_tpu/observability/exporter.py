@@ -23,6 +23,7 @@ advertisement written by observability.setup().
 
 import json
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -57,12 +58,21 @@ class _Handler(BaseHTTPRequestHandler):
             if provider is None:
                 self.send_error(404)
                 return
+            t0 = time.perf_counter()
             try:
                 body = json.dumps(provider()).encode()
             except Exception:
                 # A half-updated summary must not kill the probe endpoint.
                 self.send_error(500)
                 return
+            # Only the master carries a summary provider, so this series
+            # appears exactly where it is meaningful: the cost of
+            # rendering /api/summary grows with fleet size and `edl
+            # dash` polls it every interval.
+            self.registry.histogram(
+                "edl_master_summary_render_seconds",
+                "Time to render the /api/summary JSON body",
+            ).observe(time.perf_counter() - t0)
             self._respond(200, body, "application/json", send_body)
         elif path == "/debug/profile":
             # On-demand jax.profiler capture of THIS process

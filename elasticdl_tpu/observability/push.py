@@ -1,0 +1,198 @@
+"""Push-based telemetry: delta-encoded metric snapshots from workers/PS
+to the master's ReportTelemetry RPC.
+
+The pull model (aggregator scrapes every /metrics endpoint each interval)
+costs the master O(n) HTTP round-trips and full-text parses per tick — at
+500 pods the scrape fan-out dominates the control plane. This module
+inverts the flow: each reporting process keeps the families it last sent
+(`TelemetryPusher`) and ships only the samples whose values changed since
+(`snapshot_delta`), on a jittered interval (`TelemetryReporter`) so the
+fleet doesn't dogpile the master in lockstep. The master merges deltas
+back into per-origin state with `apply_delta` and ingests the merged
+families directly — no text parse on the hot path.
+
+Loss recovery is sequence-numbered: every snapshot carries a per-process
+`seq`; the master accepts a delta only when it extends the state it holds
+(seq == last+1) and otherwise answers `need_full`, which makes the
+reporter resend a full snapshot next push. Every Nth push is full anyway
+(ELASTICDL_TELEMETRY_FULL_EVERY) to bound the resync horizon.
+
+Deltas never need tombstones: a MetricsRegistry only ever grows samples
+(counters/gauges persist once created), so "changed or new" covers the
+whole state evolution.
+"""
+
+import collections
+import os
+import random
+import threading
+
+from elasticdl_tpu.common import knobs
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.observability import promtext
+
+logger = get_logger(__name__)
+
+PUSH_INTERVAL_ENV = "ELASTICDL_TELEMETRY_PUSH_INTERVAL"
+PUSH_JITTER_ENV = "ELASTICDL_TELEMETRY_PUSH_JITTER"
+FULL_EVERY_ENV = "ELASTICDL_TELEMETRY_FULL_EVERY"
+
+
+def snapshot_delta(prev_families, cur_families):
+    """Families holding only the samples that changed (or are new) in
+    `cur_families` relative to `prev_families`; families with no changed
+    samples are omitted entirely. Both sides are promtext-style ordered
+    {name: MetricFamily} dicts; the inputs are not mutated."""
+    prev_values = {}
+    for family in prev_families.values():
+        for s in family.samples:
+            prev_values[(s.name, s.labels)] = s.value
+    delta = collections.OrderedDict()
+    for name, family in cur_families.items():
+        changed = [
+            s for s in family.samples
+            if prev_values.get((s.name, s.labels)) != s.value
+        ]
+        if changed:
+            out = promtext.MetricFamily(family.name, family.type, family.help)
+            out.samples = changed
+            delta[name] = out
+    return delta
+
+
+def apply_delta(state_families, delta_families):
+    """Merge a delta into `state_families` in place (and return it).
+    Changed samples replace their (name, labels) slot; new samples and
+    families append, preserving the order both sides emitted them in."""
+    for name, family in delta_families.items():
+        cur = state_families.get(name)
+        if cur is None:
+            cur = promtext.MetricFamily(family.name, family.type, family.help)
+            state_families[name] = cur
+        index = {
+            (s.name, s.labels): i for i, s in enumerate(cur.samples)
+        }
+        for s in family.samples:
+            i = index.get((s.name, s.labels))
+            if i is None:
+                cur.samples.append(s)
+            else:
+                cur.samples[i] = s
+    return state_families
+
+
+class TelemetryPusher:
+    """Delta-encoding state machine for one process's registry.
+
+    `snapshot()` returns the kwargs for one pb.TelemetrySnapshot (the
+    proto module is deliberately not imported here so the fleet harness
+    and tests can use pushers without gRPC). `reset()` forces the next
+    snapshot to be full — call it when the master answers need_full.
+    """
+
+    def __init__(self, registry, role, full_every=None):
+        self._registry = registry
+        self.role = role
+        self.pid = os.getpid()
+        self._seq = 0
+        self._last = None  # families as of the last snapshot sent
+        if full_every is None:
+            full_every = knobs.get_int(FULL_EVERY_ENV)
+        self._full_every = max(0, int(full_every))
+        self._lock = threading.Lock()
+
+    def reset(self):
+        with self._lock:
+            self._last = None
+
+    def snapshot(self):
+        """-> {role, pid, seq, full, payload} for one TelemetrySnapshot.
+        An unchanged registry still yields a (payload-empty) delta: the
+        push doubles as the role's freshness heartbeat."""
+        families = promtext.parse(self._registry.expose())
+        with self._lock:
+            self._seq += 1
+            full = self._last is None or (
+                self._full_every and self._seq % self._full_every == 0
+            )
+            payload_families = (
+                families if full else snapshot_delta(self._last, families)
+            )
+            self._last = families
+            seq = self._seq
+        payload = promtext.to_text(payload_families) if payload_families else ""
+        return {
+            "role": self.role,
+            "pid": self.pid,
+            "seq": seq,
+            "full": bool(full),
+            "payload": payload,
+        }
+
+
+class TelemetryReporter:
+    """Background push loop for one process: snapshot the registry on a
+    jittered interval and report it through `report_fn` (typically
+    MasterClient.report_telemetry). Failures are counted and retried on
+    the next tick — telemetry must never take a trainer down."""
+
+    def __init__(self, report_fn, registry, role,
+                 interval=None, jitter=None, full_every=None, seed=None):
+        self._report = report_fn
+        self._pusher = TelemetryPusher(registry, role, full_every=full_every)
+        self.role = role
+        if interval is None:
+            interval = knobs.get_float(PUSH_INTERVAL_ENV)
+        if jitter is None:
+            jitter = knobs.get_float(PUSH_JITTER_ENV)
+        self.interval = float(interval)
+        self._jitter = max(0.0, float(jitter))
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread = None
+        self.pushes = 0
+        self.errors = 0
+
+    @property
+    def enabled(self):
+        return self.interval > 0
+
+    def start(self):
+        if not self.enabled or self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._run, name=f"telemetry-push-{self.role}", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def push_once(self):
+        """One synchronous push; True when the master accepted it."""
+        snap = self._pusher.snapshot()
+        try:
+            resp = self._report([snap], origin=self.role)
+        except Exception as e:  # gRPC errors must not leak to the trainer
+            self.errors += 1
+            logger.debug("telemetry push failed: %s", e)
+            return False
+        self.pushes += 1
+        if resp is not None and self.role in tuple(
+            getattr(resp, "need_full", ())
+        ):
+            self._pusher.reset()
+        return True
+
+    def _run(self):
+        while not self._stop.is_set():
+            wait = self.interval
+            if self._jitter:
+                wait *= 1.0 + self._jitter * (2.0 * self._rng.random() - 1.0)
+            if self._stop.wait(max(0.01, wait)):
+                break
+            self.push_once()
+
+    def close(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

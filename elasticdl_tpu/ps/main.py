@@ -59,8 +59,24 @@ def main(argv=None):
         except grpc.RpcError:
             return False
 
+    # Push-based telemetry (opt-in via ELASTICDL_TELEMETRY_PUSH_INTERVAL):
+    # fresh pushes take this shard off the master's pull-scrape list.
+    reporter = None
+    if mc is not None:
+        from elasticdl_tpu.observability.metrics import default_registry
+        from elasticdl_tpu.observability.push import TelemetryReporter
+
+        reporter = TelemetryReporter(
+            mc.report_telemetry,
+            default_registry(),
+            role=f"ps-{args.ps_id}",
+            seed=args.ps_id,
+        ).start()
+
     ps.wait(master_liveness_check=master_alive, poll_seconds=10)
     ps.stop()
+    if reporter is not None:
+        reporter.close()
     obs.close()
     return 0
 

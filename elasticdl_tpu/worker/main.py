@@ -155,6 +155,18 @@ def main(argv=None):
             and args.multi_host
         ),
     )
+    # Push-based telemetry (opt-in via ELASTICDL_TELEMETRY_PUSH_INTERVAL):
+    # while the reporter's pushes stay fresh the master's aggregator stops
+    # pull-scraping this worker's /metrics endpoint.
+    from elasticdl_tpu.observability.metrics import default_registry
+    from elasticdl_tpu.observability.push import TelemetryReporter
+
+    reporter = TelemetryReporter(
+        mc.report_telemetry,
+        default_registry(),
+        role=f"worker-{args.worker_id}",
+        seed=args.worker_id,
+    ).start()
     try:
         worker.run()
     finally:
@@ -164,6 +176,7 @@ def main(argv=None):
         close = getattr(trainer, "close", None)
         if close is not None:
             close()
+        reporter.close()
         obs.close()
     logger.info("Worker %d exiting", args.worker_id)
     return 0

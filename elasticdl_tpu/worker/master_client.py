@@ -94,6 +94,17 @@ class MasterClient:
             )
         )
 
+    def report_telemetry(self, snapshots, origin=""):
+        """Push a batch of (possibly delta-encoded) metric snapshots.
+        snapshots: iterable of pb.TelemetrySnapshot (or kwargs dicts)."""
+        req = pb.ReportTelemetryRequest(origin=origin)
+        for snap in snapshots:
+            if isinstance(snap, dict):
+                req.snapshots.add(**snap)
+            else:
+                req.snapshots.append(snap)
+        return self._stub.report_telemetry(req)
+
     def report_liveness(self):
         return self._stub.report_worker_liveness(
             pb.ReportWorkerLivenessRequest(

@@ -42,6 +42,8 @@ import urllib.request
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from elasticdl_tpu.common import knobs  # noqa: E402
+
 SCENARIOS = (
     "worker-kill",
     "ps-flap",
@@ -441,6 +443,16 @@ def run_drill(
         result["leftover_procs"] = [line for _, line in leftovers]
         for pid, _ in leftovers:
             chaos_process.deliver(pid, signal.SIGKILL)
+        # Heartbeat-driven sweep for trees from EARLIER crashed drills
+        # (this drill's own master heartbeat is fresh or already gone).
+        try:
+            from reap_orphans import reap as reap_heartbeats
+
+            heartbeat_dir = knobs.get_str("ELASTICDL_HEARTBEAT_DIR")
+            if heartbeat_dir:
+                reap_heartbeats(heartbeat_dir)
+        except Exception:
+            pass
 
 
 def _master_endpoint(obs_dir):

@@ -1,0 +1,157 @@
+"""Kill orphaned `edl train` process trees via stale master heartbeats.
+
+Every master writes `<ELASTICDL_HEARTBEAT_DIR>/<job>-<pid>.json` on a
+short period (common/heartbeat.py). A driver that dies uncleanly —
+SIGKILL, OOM, a wedged test runner — leaves that heartbeat frozen while
+its process group (master + workers + PS) lives on, squatting on ports
+and CPU that poison every later bench/chaos run on the machine. This
+tool sweeps the heartbeat directory and:
+
+  - removes heartbeats whose pid is gone (clean-ish deaths),
+  - SIGKILLs the recorded process group when the heartbeat is stale AND
+    the pid still runs the recorded cmdline (pid reuse never matches, so
+    an unrelated process that landed on a recycled pid is spared),
+  - leaves fresh heartbeats alone.
+
+Staleness is `--stale-seconds`, or 3x the heartbeat's own recorded
+period (min 30 s) when not given. Run it from `make chaos` / bench
+pre-steps and drill teardowns; `--dry-run` only reports.
+
+Exit code: 0 always (a reaper that fails the build it guards is worse
+than no reaper); the summary line says what happened.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from elasticdl_tpu.common import knobs  # noqa: E402
+from elasticdl_tpu.common.heartbeat import (  # noqa: E402
+    HEARTBEAT_DIR_ENV,
+    read_cmdline,
+)
+
+
+def reap(directory, stale_seconds=None, dry_run=False, now=None,
+         kill=os.killpg):
+    """Sweep one heartbeat dir; -> {"killed", "removed", "fresh",
+    "skipped"} lists of heartbeat paths. `kill` is injectable so tests
+    can assert the decision without shooting real process groups."""
+    now = time.time() if now is None else now
+    out = {"killed": [], "removed": [], "fresh": [], "skipped": []}
+    try:
+        entries = sorted(os.listdir(directory))
+    except OSError:
+        return out
+    own_pgid = os.getpgid(0)
+    for entry in entries:
+        if not entry.endswith(".json"):
+            continue
+        path = os.path.join(directory, entry)
+        try:
+            with open(path) as f:
+                record = json.load(f)
+        except (OSError, ValueError):
+            # Torn write of a live master, or garbage: only remove it
+            # once it is old enough that no live writer owns it.
+            try:
+                if now - os.path.getmtime(path) > 300:
+                    if not dry_run:
+                        os.unlink(path)
+                    out["removed"].append(path)
+                else:
+                    out["skipped"].append(path)
+            except OSError:
+                pass
+            continue
+        pid = record.get("pid")
+        pgid = record.get("pgid")
+        ts = record.get("ts", 0)
+        stale_after = stale_seconds
+        if stale_after is None:
+            stale_after = max(30.0, 3.0 * record.get("period_s", 10.0))
+        live_cmdline = read_cmdline(pid) if pid else None
+        if live_cmdline is None:
+            # Process gone; the heartbeat is litter.
+            if not dry_run:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            out["removed"].append(path)
+            continue
+        if now - ts <= stale_after:
+            out["fresh"].append(path)
+            continue
+        recorded = record.get("cmdline", "")
+        if not recorded or live_cmdline != recorded or not pgid:
+            # Pid reuse (different command) or a record too thin to
+            # verify: never signal on a guess.
+            out["skipped"].append(path)
+            continue
+        if pgid in (own_pgid, 0, 1):
+            out["skipped"].append(path)
+            continue
+        if not dry_run:
+            try:
+                kill(pgid, signal.SIGKILL)
+            except OSError:
+                pass
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        out["killed"].append(path)
+    return out
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Reap orphaned edl process groups via stale master "
+        "heartbeats"
+    )
+    parser.add_argument(
+        "--dir",
+        default=None,
+        help="heartbeat directory (default: ELASTICDL_HEARTBEAT_DIR)",
+    )
+    parser.add_argument(
+        "--stale-seconds",
+        type=float,
+        default=None,
+        help="override staleness threshold (default: 3x each "
+        "heartbeat's recorded period, min 30s)",
+    )
+    parser.add_argument(
+        "--dry-run", action="store_true", help="report, touch nothing"
+    )
+    args = parser.parse_args(argv)
+    directory = args.dir or knobs.get_str(HEARTBEAT_DIR_ENV)
+    if not directory:
+        print("reap_orphans: no heartbeat dir configured; nothing to do")
+        return 0
+    result = reap(
+        directory,
+        stale_seconds=args.stale_seconds,
+        dry_run=args.dry_run,
+    )
+    tag = "would kill" if args.dry_run else "killed"
+    print(
+        f"reap_orphans: {tag} {len(result['killed'])} group(s), "
+        f"removed {len(result['removed'])} dead heartbeat(s), "
+        f"{len(result['fresh'])} fresh, {len(result['skipped'])} skipped"
+        f" in {directory}"
+    )
+    for path in result["killed"]:
+        print(f"  {tag}: {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
