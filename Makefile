@@ -75,6 +75,13 @@ obs: reap
 fleet-smoke: reap
 	set -o pipefail; timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly
 
+# The policy acceptance drills (docs/POLICY.md catalog): real multi-
+# process jobs where the self-healing engine must detect the fault AND
+# throughput must recover — straggler blacklist, backup-task win,
+# deadline scale-up with the world-hint handshake, preemption wave.
+policy-drill: reap
+	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/test_policy_drill.py -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly
+
 native:
 	@if [ -f elasticdl_tpu/native/Makefile ]; then $(MAKE) -C elasticdl_tpu/native; else echo "native kernels not present yet"; fi
 
@@ -82,14 +89,15 @@ native:
 # even when an earlier one fails (one run answers "what is broken"), and
 # the single trailing CI: line is the machine-readable verdict.
 ci:
-	@lint=FAIL; tier1=FAIL; gate=FAIL; fleet=FAIL; \
+	@lint=FAIL; tier1=FAIL; gate=FAIL; fleet=FAIL; policy=FAIL; \
 	set -o pipefail; lintlog=$$(mktemp); \
 	$(MAKE) --no-print-directory lint 2>&1 | tee $$lintlog && lint=ok; \
 	$(MAKE) --no-print-directory verify-tests && tier1=ok; \
 	$(MAKE) --no-print-directory fleet-smoke && fleet=ok; \
+	$(MAKE) --no-print-directory policy-drill && policy=ok; \
 	$(MAKE) --no-print-directory bench-gate && gate=ok; \
 	rules=$$(grep -ao 'per-rule: .*' $$lintlog | tail -1); rm -f $$lintlog; \
-	echo "CI: lint=$$lint tier1=$$tier1 fleet=$$fleet bench-gate=$$gate$${rules:+ [$$rules]}"; \
-	[ "$$lint" = ok ] && [ "$$tier1" = ok ] && [ "$$fleet" = ok ] && [ "$$gate" = ok ]
+	echo "CI: lint=$$lint tier1=$$tier1 fleet=$$fleet policy=$$policy bench-gate=$$gate$${rules:+ [$$rules]}"; \
+	[ "$$lint" = ok ] && [ "$$tier1" = ok ] && [ "$$fleet" = ok ] && [ "$$policy" = ok ] && [ "$$gate" = ok ]
 
-.PHONY: proto test verify verify-tests reap bench-smoke bench-gate lint lint-changed chaos obs fleet-smoke native ci
+.PHONY: proto test verify verify-tests reap bench-smoke bench-gate lint lint-changed chaos obs fleet-smoke policy-drill native ci

@@ -7,6 +7,7 @@ needles) uniquely identifies one process without tracking pids across
 relaunches."""
 
 import os
+import random
 import signal
 import subprocess
 import time
@@ -76,6 +77,33 @@ def kill_role(role, instance_id, master_port, timeout=60):
     logger.info("chaos: SIGKILL %s %d (pid %d)", role, instance_id, pid)
     deliver(pid, signal.SIGKILL)
     return pid
+
+
+def preemption_wave(n_workers, master_port, fraction=0.3, seed=0,
+                    timeout=60):
+    """SIGKILL a seeded fraction of the job's workers in one sweep — the
+    spot/maintenance preemption wave, process edition. Victims are drawn
+    deterministically from (n_workers, fraction, seed); workers that are
+    already gone are skipped. Returns [(worker_id, pid), ...] actually
+    killed."""
+    rng = random.Random(seed)
+    n_victims = max(1, int(round(n_workers * fraction)))
+    victims = sorted(
+        rng.sample(range(n_workers), min(n_workers, n_victims))
+    )
+    logger.info(
+        "chaos: preemption wave over workers %s (%.0f%% of %d)",
+        victims, 100 * fraction, n_workers,
+    )
+    killed = []
+    for wid in victims:
+        try:
+            pid = find_role_pid("worker", wid, master_port, timeout)
+        except RuntimeError:
+            continue
+        if deliver(pid, signal.SIGKILL):
+            killed.append((wid, pid))
+    return killed
 
 
 def stall(pid, seconds):

@@ -271,6 +271,13 @@ def _top_summary_line(status, first_records, first_ts, now):
         avg = (status.records_done - first_records) / (now - first_ts)
         rate = f" avg={avg:.1f} rec/s"
     stragglers = ",".join(status.stragglers) or "none"
+    policy = f"policy: actions={status.policy_actions}"
+    if status.policy_blacklisted:
+        policy += f" blacklist={','.join(status.policy_blacklisted)}"
+    if status.backup_wins:
+        policy += f" backup_wins={status.backup_wins}"
+    if status.backup_tasks_inflight:
+        policy += f" backups_inflight={status.backup_tasks_inflight}"
     return (
         f"summary: records={status.records_done}{rate} "
         f"stragglers={stragglers} "
@@ -278,6 +285,8 @@ def _top_summary_line(status, first_records, first_ts, now):
         f"recovered={status.tasks_recovered} "
         f"alerts={status.alerts_fired}"
         + (" FAILED" if status.job_failed else "")
+        + "\n"
+        + policy
     )
 
 
@@ -459,6 +468,16 @@ def _top(args):
             elastic += f" stragglers={','.join(status.stragglers)}"
         if status.alerts_fired:
             elastic += f" alerts={status.alerts_fired}"
+        if status.policy_actions:
+            elastic += f" policy={status.policy_actions}"
+        if status.policy_blacklisted:
+            elastic += (
+                f" blacklist={','.join(status.policy_blacklisted)}"
+            )
+        if status.backup_tasks_inflight:
+            elastic += f" backups={status.backup_tasks_inflight}"
+        if status.backup_wins:
+            elastic += f" backup_wins={status.backup_wins}"
         print(
             f"epoch {status.epoch}/{status.num_epochs} "
             f"v{status.model_version} "

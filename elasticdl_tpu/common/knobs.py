@@ -374,6 +374,67 @@ declare("ELASTICDL_FLIGHTREC_DIR", "str", "",
         "Directory for flightrec-<role>.json dumps; empty falls back "
         "to ELASTICDL_OBS_DIR, then the working directory.")
 
+# -- policy engine (master/policy.py) --
+declare("ELASTICDL_POLICY", "str", "",
+        "1/true enables the master's self-healing policy engine (the "
+        "control loop that blacklists stragglers, launches speculative "
+        "backup tasks, and scales on drain ETA). Unset/0 leaves the "
+        "loop off — detection-only, exactly the pre-policy behavior.")
+declare("ELASTICDL_POLICY_INTERVAL", "float", 2.0,
+        "Policy evaluation period in seconds (each tick reads the "
+        "aggregator summary and evaluates every rule once).")
+declare("ELASTICDL_POLICY_DRY_RUN", "str", "",
+        "1/true makes the policy engine evaluate rules and emit "
+        "policy_decision events with outcome=dry_run without actuating "
+        "anything — the rehearsal mode for tuning thresholds.")
+declare("ELASTICDL_POLICY_HYSTERESIS", "int", 3,
+        "Consecutive policy ticks a rule's condition must hold before "
+        "it fires (one clean tick resets the counter); the flap guard.")
+declare("ELASTICDL_POLICY_COOLDOWN_SECONDS", "float", 30.0,
+        "Per-(action, subject) cooldown: after an action applies, the "
+        "same action on the same subject is suppressed this long.")
+declare("ELASTICDL_POLICY_RATE_LIMIT", "int", 6,
+        "Global cap on applied policy actions per 60 s sliding window; "
+        "further decisions in the window land as outcome=rate_limited.")
+declare("ELASTICDL_POLICY_STRAGGLER_SCORE", "float", 3.0,
+        "Straggler-mitigation trigger: a worker whose aggregator "
+        "straggler_score (EWMA step latency over fleet median) stays "
+        "at or above this for the hysteresis window is blacklisted "
+        "and relaunched.")
+declare("ELASTICDL_POLICY_BLACKLIST_SECONDS", "float", 60.0,
+        "TTL of a dispatcher blacklist entry created by the straggler "
+        "rule; expiry re-admits the worker even if its relaunch never "
+        "completed (self-healing default).")
+declare("ELASTICDL_POLICY_MAX_BACKUPS", "int", 2,
+        "Upper bound on speculative backup task copies in flight at "
+        "once; 0 disables the backup-task rule.")
+declare("ELASTICDL_POLICY_BACKUP_FACTOR", "float", 3.0,
+        "Backup-task trigger: an in-flight training task whose elapsed "
+        "time exceeds this multiple of the recent mean task duration "
+        "gets a speculative second copy on a healthy worker.")
+declare("ELASTICDL_POLICY_SCALE_STEP", "int", 1,
+        "How many workers one drain-ETA scaling decision adds or "
+        "retires (the k in ±k).")
+declare("ELASTICDL_POLICY_MAX_WORKERS", "int", 0,
+        "Ceiling for policy-driven scale-up; 0 defaults to twice the "
+        "job's initial worker count.")
+declare("ELASTICDL_POLICY_HINT_POLL_SECONDS", "float", 2.0,
+        "How often a worker polls the master's world-hint RPC so the "
+        "AOT speculator compiles the announced next world instead of "
+        "guessing N±delta; 0 disables polling.")
+declare("ELASTICDL_JOB_DEADLINE_SECONDS", "float", 0.0,
+        "Soft job deadline for the drain-ETA scaling rule: when the "
+        "aggregator's task-drain ETA overshoots the time remaining, "
+        "the policy engine asks the instance manager for more workers "
+        "(and retires them when far ahead). 0 disables the rule.")
+
+# -- task lease batching (master/task_dispatcher.py, worker/) --
+declare("ELASTICDL_TASK_LEASE_BATCH", "int", 1,
+        "Tasks a worker leases per GetTask RPC (results are reported "
+        "in matching batches); 1 keeps the classic one-task-per-RPC "
+        "protocol. Raising it divides dispatch RPC load at fleet "
+        "scale.")
+
 # -- chaos (chaos/injection.py) --
 declare("ELASTICDL_CHAOS", "str", "",
         "JSON fault schedule injected into the rpc plane; set by drills, "

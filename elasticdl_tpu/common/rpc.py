@@ -101,7 +101,10 @@ MASTER_SERVICE = ServiceSpec(
     name="elasticdl_tpu.Master",
     methods={
         "get_task": (pb.GetTaskRequest, pb.Task),
+        # Lease batching: up to max_tasks tasks per RPC, batched reports.
+        "get_task_batch": (pb.GetTaskRequest, pb.TaskBatch),
         "report_task_result": (pb.ReportTaskResultRequest, pb.Empty),
+        "report_task_results": (pb.ReportTaskResultsRequest, pb.Empty),
         "report_evaluation_metrics": (pb.ReportEvaluationMetricsRequest, pb.Empty),
         "report_version": (pb.ReportVersionRequest, pb.Empty),
         "get_comm_rank": (pb.GetCommRankRequest, pb.GetCommRankResponse),
@@ -114,6 +117,9 @@ MASTER_SERVICE = ServiceSpec(
             pb.ReportTelemetryRequest,
             pb.ReportTelemetryResponse,
         ),
+        # Policy plane: workers poll the announced next world so the AOT
+        # speculator compiles it instead of guessing N±delta.
+        "get_world_hint": (pb.GetWorldHintRequest, pb.WorldHintResponse),
     },
 )
 
@@ -195,7 +201,14 @@ METHOD_POLICIES = {
     # Master service: small control messages; get_task answers WAIT rather
     # than blocking, so short deadlines are safe.
     "get_task": RetryPolicy(deadline=30.0),
+    # Batched leases share get_task's semantics: a replayed lease at worst
+    # strands tasks in _doing for the watchdog to recover, same as today.
+    "get_task_batch": RetryPolicy(deadline=30.0),
     "report_task_result": RetryPolicy(deadline=30.0),
+    # Duplicate reports are absorbed server-side (unknown/duplicate ids are
+    # acknowledged and discarded), so the batch report retries like the
+    # single-task one.
+    "report_task_results": RetryPolicy(deadline=30.0),
     "report_evaluation_metrics": RetryPolicy(deadline=60.0),
     "report_version": RetryPolicy(deadline=30.0),
     "get_comm_rank": RetryPolicy(deadline=30.0),
@@ -203,6 +216,9 @@ METHOD_POLICIES = {
     "report_lease": RetryPolicy(deadline=30.0),
     "report_worker_liveness": RetryPolicy(deadline=30.0),
     "get_job_status": RetryPolicy(deadline=15.0),
+    # Hint polls are periodic and read-only; a missed poll self-heals on
+    # the next interval, so don't burn retry budget.
+    "get_world_hint": RetryPolicy(deadline=10.0, max_attempts=2),
     # Telemetry pushes are periodic and self-healing (a lost snapshot is
     # resent as a full resync on the next interval), so a failed push is
     # never worth burning retry budget on: one connectivity retry, and a

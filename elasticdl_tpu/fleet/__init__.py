@@ -27,4 +27,5 @@ from elasticdl_tpu.fleet.harness import (  # noqa: F401
     SimPod,
     build_relay_chain,
     churn_schedule,
+    preemption_wave_schedule,
 )

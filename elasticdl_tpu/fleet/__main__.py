@@ -12,7 +12,11 @@ import argparse
 import json
 import sys
 
-from elasticdl_tpu.fleet.harness import FleetHarness, churn_schedule
+from elasticdl_tpu.fleet.harness import (
+    FleetHarness,
+    churn_schedule,
+    preemption_wave_schedule,
+)
 
 
 def main(argv=None):
@@ -36,12 +40,25 @@ def main(argv=None):
                         help="pods killed (and relaunched) by chaos")
     parser.add_argument("--stragglers", type=int, default=0,
                         help="pods slowed 4x for a chaos window")
+    parser.add_argument("--preemption-wave", type=float, default=0.0,
+                        help="kill this fraction of pods in ONE tick "
+                             "(overrides --kills/--stragglers)")
+    parser.add_argument("--lease-batch", type=int, default=1,
+                        help="tasks leased/reported per RPC (batched "
+                             "protocol when > 1)")
+    parser.add_argument("--policy", action="store_true",
+                        help="run the real policy engine against the "
+                             "simulated fleet")
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
 
     n_ps = min(args.ps, args.pods)
     schedule = None
-    if args.kills or args.stragglers:
+    if args.preemption_wave > 0:
+        schedule = preemption_wave_schedule(
+            args.pods, fraction=args.preemption_wave, seed=args.seed
+        )
+    elif args.kills or args.stragglers:
         schedule = churn_schedule(
             args.pods, kills=args.kills, stragglers=args.stragglers,
             seed=args.seed,
@@ -54,6 +71,8 @@ def main(argv=None):
         push_interval=args.push_interval,
         schedule=schedule,
         seed=args.seed,
+        lease_batch=args.lease_batch,
+        policy=args.policy,
     )
     try:
         harness.start()

@@ -76,6 +76,27 @@ def churn_schedule(n_pods, kills=0, stragglers=0, start_tick=5,
     return FaultSchedule(rules, seed=seed)
 
 
+def preemption_wave_schedule(n_pods, fraction=0.2, at_tick=5,
+                             window_ticks=6, seed=0):
+    """A seeded FaultSchedule killing fraction*n_pods pods in ONE tick
+    (the spot/maintenance preemption wave), all relaunching together
+    after `window_ticks`. Deterministic in (n_pods, fraction, seed)."""
+    rng = random.Random(seed)
+    n_victims = max(1, int(round(n_pods * fraction)))
+    victims = rng.sample(range(n_pods), min(n_pods, n_victims))
+    rules = [
+        {
+            "method": f"pod-{pod:04d}",
+            "kind": "unavailable",
+            "start": at_tick,
+            "count": window_ticks,
+            "side": "client",
+        }
+        for pod in victims
+    ]
+    return FaultSchedule(rules, seed=seed)
+
+
 class Relay:
     """One stage of the push-aggregation tree: buffers snapshots and
     forwards them to `sink` (another Relay's submit, or the root's RPC)
@@ -147,6 +168,8 @@ class SimPod:
         self.alive = True
         self.straggler_factor = 1.0
         self.task_id = None
+        self.leased = []  # batched-lease buffer (lease_batch > 1)
+        self.unreported = []  # completed ids awaiting a batch report
         self.last_push = 0.0
         self._rng = random.Random(
             (harness.seed << 20) ^ (index << 4) ^ incarnation
@@ -220,6 +243,8 @@ class SimPod:
         exactly the stale-endpoint case the aggregator must absorb."""
         self.alive = False
         self.task_id = None
+        self.leased = []
+        self.unreported = []
         if self.exporter is not None:
             self.exporter.close()
             self.exporter = None
@@ -277,6 +302,8 @@ class SimPod:
             self.harness.submit_push(self, self.pusher.snapshot())
 
     def _task_rpc(self):
+        if self.harness.lease_batch > 1:
+            return self._task_rpc_batched()
         stub = self.harness.stub
         try:
             if self.task_id is None:
@@ -295,13 +322,45 @@ class SimPod:
         except Exception:
             self.harness.count("rpc_errors")
 
+    def _task_rpc_batched(self):
+        """Batched lease protocol, still at most ONE task RPC per tick:
+        an empty buffer refills with get_task_batch; otherwise one task
+        'completes' per tick and a full unreported buffer flushes as one
+        report_task_results — so each RPC moves lease_batch tasks."""
+        stub = self.harness.stub
+        batch = self.harness.lease_batch
+        try:
+            if self.unreported and (
+                len(self.unreported) >= batch or not self.leased
+            ):
+                req = pb.ReportTaskResultsRequest()
+                for tid in self.unreported:
+                    req.results.add(task_id=tid)
+                stub.report_task_results(req)
+                self.harness.count("reported", len(self.unreported))
+                self.unreported = []
+            elif not self.leased:
+                res = stub.get_task_batch(
+                    pb.GetTaskRequest(
+                        worker_id=self.index, max_tasks=batch
+                    )
+                )
+                if res.tasks:
+                    self.leased = [t.task_id for t in res.tasks]
+                    self.harness.count("dispatched", len(res.tasks))
+            else:
+                self.unreported.append(self.leased.pop(0))
+        except Exception:
+            self.harness.count("rpc_errors")
+
 
 class FleetMaster:
     """The real master control plane under test: dispatcher + servicer
     behind gRPC, aggregator, /api/summary exporter."""
 
     def __init__(self, obs_dir, job="fleet", n_records=1 << 20,
-                 records_per_task=64, interval=0.5):
+                 records_per_task=64, interval=0.5, policy=False,
+                 policy_kwargs=None):
         self.job = job
         self.task_d = TaskDispatcher(
             {"fleet": (0, n_records)},
@@ -321,11 +380,42 @@ class FleetMaster:
             job=job,
             interval=interval,
         )
-        self.servicer.bind_job_context(aggregator=self.aggregator)
+        self.policy = None
+        self.world_hints = None
+        if policy:
+            # The REAL policy engine against the simulated fleet: same
+            # summary input, same dispatcher actuators. No instance
+            # manager (pods aren't processes), so the straggler rule's
+            # blacklist+recover applies while restart/scale no-op. The
+            # harness master loop ticks it synchronously — deterministic
+            # decision timing instead of a second clock.
+            from elasticdl_tpu.master.policy import (
+                PolicyEngine,
+                WorldHintBoard,
+            )
+
+            self.world_hints = WorldHintBoard()
+            self.policy = PolicyEngine(
+                self.aggregator.summary,
+                self.task_d,
+                world_hints=self.world_hints,
+                **(policy_kwargs or {}),
+            )
+        self.servicer.bind_job_context(
+            aggregator=self.aggregator,
+            policy=self.policy,
+            world_hints=self.world_hints,
+        )
         self.exporter = MetricsExporter(
             default_registry(), port=0, host="127.0.0.1"
         )
-        self.exporter.summary_provider = self.aggregator.summary
+        self.exporter.summary_provider = self._summary
+
+    def _summary(self):
+        summary = self.aggregator.summary()
+        if self.policy is not None:
+            summary["policy"] = self.policy.summary()
+        return summary
 
     def close(self):
         self.exporter.close()
@@ -340,7 +430,8 @@ class FleetHarness:
                  tick_interval=0.25, push_interval=0.5,
                  push_full_every=16, relay_fanout=16, schedule=None,
                  seed=0, carriers=8, base_step_s=0.05,
-                 aggregator_interval=0.5, job="fleet"):
+                 aggregator_interval=0.5, job="fleet", lease_batch=1,
+                 policy=False, policy_kwargs=None):
         assert mode in ("push", "pull"), mode
         if obs_dir is None:
             import tempfile
@@ -358,6 +449,10 @@ class FleetHarness:
         self.seed = seed
         self.n_workers = n_workers
         self.n_ps = n_ps
+        self.lease_batch = max(1, lease_batch)
+        self._policy = policy
+        self._policy_kwargs = policy_kwargs
+        self.policy_decisions = []
         self._n_carriers = max(1, min(carriers, n_workers + n_ps))
         self._relay_fanout = relay_fanout
         self._agg_interval = aggregator_interval
@@ -421,7 +516,11 @@ class FleetHarness:
         if self.mode == "pull":
             self._raise_nofile(self.n_workers + self.n_ps)
         self.master = FleetMaster(
-            self.obs_dir, job=self.job, interval=self._agg_interval
+            self.obs_dir,
+            job=self.job,
+            interval=self._agg_interval,
+            policy=self._policy,
+            policy_kwargs=self._policy_kwargs,
         )
         self._channel = rpc.build_channel(f"127.0.0.1:{self.master.port}")
         self.stub = rpc.Stub(self._channel, rpc.MASTER_SERVICE)
@@ -454,6 +553,7 @@ class FleetHarness:
         )
         t.start()
         self._threads.append(t)
+        self._started_at = time.monotonic()
         return self
 
     @staticmethod
@@ -516,6 +616,13 @@ class FleetHarness:
             t0 = time.perf_counter()
             try:
                 self.master.aggregator.poll_once()
+                if self.master.policy is not None:
+                    # Policy rides the same tick as the aggregator:
+                    # decisions follow directly from the rollup the tick
+                    # just produced (deterministic causality for tests).
+                    self.policy_decisions.extend(
+                        self.master.policy.tick()
+                    )
             except Exception:
                 logger.warning("fleet master tick failed", exc_info=True)
             self.master_tick_seconds.append(time.perf_counter() - t0)
@@ -535,10 +642,17 @@ class FleetHarness:
             self.master.aggregator.summary() if self.master else {}
         )
         ticks = sorted(self.master_tick_seconds)
-        return {
+        elapsed = time.monotonic() - getattr(
+            self, "_started_at", time.monotonic()
+        )
+        out = {
             "mode": self.mode,
             "pods": len(self.pods),
             "counts": counts,
+            "lease_batch": self.lease_batch,
+            "dispatch_tasks_per_s": (
+                counts.get("reported", 0) / elapsed if elapsed > 0 else 0.0
+            ),
             "master_ticks": len(ticks),
             "master_tick_p50_s": ticks[len(ticks) // 2] if ticks else None,
             "master_tick_max_s": ticks[-1] if ticks else None,
@@ -546,6 +660,10 @@ class FleetHarness:
             "roles_scraped": len(summary.get("roles_scraped") or ()),
             "summary_ts": summary.get("ts"),
         }
+        if self.master is not None and self.master.policy is not None:
+            out["policy"] = self.master.policy.summary()
+            out["policy_decisions"] = list(self.policy_decisions)
+        return out
 
     def fetch_summary_http(self):
         """GET the master's /api/summary over real HTTP (render cost

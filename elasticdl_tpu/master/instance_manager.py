@@ -45,6 +45,12 @@ class _Instance:
         self.popen = popen
         self.status = PodStatus.RUNNING
         self.relaunch_count = 0
+        # Policy-driven deliberate kill: the next exit relaunches without
+        # charging the max_relaunches failure budget.
+        self.forgive_next_exit = False
+        # Policy-driven scale-down: the next exit is a clean retirement
+        # (tasks recover, membership drops, no relaunch).
+        self.retired = False
 
 
 class LocalProcessInstanceManager:
@@ -166,6 +172,18 @@ class LocalProcessInstanceManager:
             instance=f"{inst.kind}-{inst.id}",
             exit_code=code,
         )
+        if inst.retired:
+            # Deliberate scale-down: the exit is the retirement completing,
+            # whatever the exit code. Tasks recover, membership drops, and
+            # the instance counts as done — never as a failure.
+            inst.status = PodStatus.SUCCEEDED
+            logger.info("%s %d retired (scale-down)", inst.kind, inst.id)
+            if inst.kind == "worker":
+                if self._task_d is not None:
+                    self._task_d.recover_tasks(inst.id)
+                if self._membership is not None:
+                    self._membership.remove_worker(inst.id)
+            return
         if code == 0:
             inst.status = PodStatus.SUCCEEDED
             logger.info("%s %d finished", inst.kind, inst.id)
@@ -183,11 +201,14 @@ class LocalProcessInstanceManager:
                 self._task_d.recover_tasks(inst.id)
             if self._membership is not None:
                 self._membership.remove_worker(inst.id)
-        relaunch = inst.relaunch_count < self._max_relaunches and (
-            inst.kind == "ps" or self._restart_workers
-        )
+        forgiven = inst.forgive_next_exit
+        inst.forgive_next_exit = False
+        relaunch = (
+            forgiven or inst.relaunch_count < self._max_relaunches
+        ) and (inst.kind == "ps" or self._restart_workers)
         if relaunch:
-            inst.relaunch_count += 1
+            if not forgiven:
+                inst.relaunch_count += 1
             logger.info(
                 "Relaunching %s %d (attempt %d)",
                 inst.kind,
@@ -212,6 +233,103 @@ class LocalProcessInstanceManager:
                 "pod_failed",
                 instance=f"{inst.kind}-{inst.id}",
                 exit_code=code,
+            )
+
+    # ---------- policy actuators ----------
+
+    def restart_worker(self, worker_id, reason=""):
+        """Deliberate kill+relaunch of one worker (straggler mitigation).
+        The monitor loop performs the relaunch on its next poll; the exit
+        is forgiven, so mitigation never consumes the max_relaunches
+        failure budget. Returns False when the worker isn't running."""
+        with self._lock:
+            inst = self._instances.get(("worker", worker_id))
+            if (
+                inst is None
+                or inst.retired
+                or inst.popen.poll() is not None
+            ):
+                return False
+            inst.forgive_next_exit = True
+        _POD_EVENTS.labels(kind="worker", event="restart").inc()
+        emit_event(
+            "pod_restart",
+            instance=f"worker-{worker_id}",
+            reason=reason[:200],
+        )
+        logger.info("Restarting worker %d (%s)", worker_id, reason)
+        inst.popen.terminate()
+        return True
+
+    def scale_workers(self, delta, reason=""):
+        """Policy-driven ±k worker scaling. Positive delta launches new
+        worker ids past the current highest; negative retires the
+        highest-id running workers (tasks recover, membership drops, no
+        relaunch). Returns the affected worker ids."""
+        if delta == 0:
+            return []
+        affected = []
+        if delta > 0:
+            with self._lock:
+                worker_ids = [
+                    i.id
+                    for i in self._instances.values()
+                    if i.kind == "worker"
+                ]
+                next_id = (max(worker_ids) + 1) if worker_ids else 0
+                self._num_workers = max(
+                    self._num_workers, next_id + delta
+                )
+            for wid in range(next_id, next_id + delta):
+                self._launch("worker", wid)
+                affected.append(wid)
+        else:
+            with self._lock:
+                victims = sorted(
+                    (
+                        i
+                        for i in self._instances.values()
+                        if i.kind == "worker"
+                        and not i.retired
+                        and i.status == PodStatus.RUNNING
+                    ),
+                    key=lambda i: -i.id,
+                )[:-delta]
+                for inst in victims:
+                    inst.retired = True
+                self._num_workers = max(
+                    0, self._num_workers - len(victims)
+                )
+            for inst in victims:
+                affected.append(inst.id)
+                if inst.popen.poll() is None:
+                    inst.popen.terminate()
+        if affected:
+            event = "scale_up" if delta > 0 else "scale_down"
+            _POD_EVENTS.labels(kind="worker", event=event).inc(
+                len(affected)
+            )
+            emit_event(
+                "pod_scale",
+                delta=delta,
+                workers=affected,
+                reason=reason[:200],
+            )
+            logger.info(
+                "Scaled workers %+d (%s): %s", delta, reason, affected
+            )
+        return affected
+
+    def worker_count(self):
+        """Workers currently part of the job (running or pending relaunch;
+        retired and terminally failed ones excluded)."""
+        with self._lock:
+            return sum(
+                1
+                for i in self._instances.values()
+                if i.kind == "worker"
+                and not i.retired
+                and i.status != PodStatus.FAILED
             )
 
     # ---------- status ----------

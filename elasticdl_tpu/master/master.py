@@ -201,6 +201,8 @@ class Master:
         self._server = None
         self.port = None
         self.aggregator = None
+        self.policy = None
+        self.world_hints = None
         self.instance_manager = self._build_instance_manager(args)
 
     # ---------- instance manager wiring ----------
@@ -390,10 +392,31 @@ class Master:
                 self.obs.exporter.summary_provider = (
                     self.aggregator.summary
                 )
+        from elasticdl_tpu.master.policy import (
+            PolicyEngine,
+            WorldHintBoard,
+            policy_enabled,
+        )
+
+        self.world_hints = WorldHintBoard()
+        if policy_enabled() and self.aggregator is not None:
+            # The closed loop: aggregator signals -> rules -> actuators.
+            # Scale decisions announce through the world-hint board first
+            # so workers AOT-compile the announced world before it forms.
+            self.policy = PolicyEngine(
+                self.aggregator.summary,
+                self.task_d,
+                instance_manager=self.instance_manager,
+                world_hints=self.world_hints,
+            ).start()
+            if self.obs.exporter is not None:
+                self.obs.exporter.summary_provider = self._summary
         self.servicer.bind_job_context(
             instance_manager=self.instance_manager,
             metrics_port=self.obs.metrics_port,
             aggregator=self.aggregator,
+            policy=self.policy,
+            world_hints=self.world_hints,
         )
         if self.instance_manager is not None:
             if self.args.num_ps:
@@ -419,6 +442,14 @@ class Master:
                 logger.warning(
                     "TensorBoard service creation failed", exc_info=True
                 )
+
+    def _summary(self):
+        """Aggregator summary with the policy plane merged in, so
+        /api/summary (and `edl dash`) shows decisions next to signals."""
+        summary = self.aggregator.summary()
+        if self.policy is not None:
+            summary["policy"] = self.policy.summary()
+        return summary
 
     def run(self, poll_seconds=None):
         """Poll until done/failed (reference master.py:238-263). Returns the
@@ -532,6 +563,9 @@ class Master:
         if heartbeat is not None:
             heartbeat.close()
             self._heartbeat = None
+        if self.policy is not None:
+            self.policy.close()
+            self.policy = None
         if self.aggregator is not None:
             self.aggregator.close()
             self.aggregator = None

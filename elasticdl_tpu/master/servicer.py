@@ -45,14 +45,23 @@ class MasterServicer:
         self._instance_manager = None
         self._metrics_port = 0
         self._aggregator = None
+        self._policy = None
+        self._world_hints = None
 
     def bind_job_context(
-        self, instance_manager=None, metrics_port=0, aggregator=None
+        self,
+        instance_manager=None,
+        metrics_port=0,
+        aggregator=None,
+        policy=None,
+        world_hints=None,
     ):
         """Late-bind job-status sources created after this servicer."""
         self._instance_manager = instance_manager
         self._metrics_port = metrics_port
         self._aggregator = aggregator
+        self._policy = policy
+        self._world_hints = world_hints
 
     def _touch(self, worker_id):
         with self._lock:
@@ -92,10 +101,49 @@ class MasterServicer:
         )
         return task.to_proto(task_id)
 
+    def get_task_batch(self, request, context):
+        """Lease batching: up to max_tasks tasks in one RPC. An empty
+        batch with finished=False is the WAIT analog."""
+        self._touch(request.worker_id)
+        leased = self._task_d.get_batch(
+            request.worker_id, max(1, request.max_tasks)
+        )
+        res = pb.TaskBatch()
+        for task_id, task in leased:
+            res.tasks.append(task.to_proto(task_id))
+            tracing.instant(
+                "dispatch_task", task_id=task_id, worker=request.worker_id
+            )
+        if not leased:
+            res.finished = self._task_d.finished()
+        return res
+
     def report_task_result(self, request, context):
         success = not request.err_message
         self._task_d.report(request.task_id, success, request.err_message)
         return pb.Empty()
+
+    def report_task_results(self, request, context):
+        """Batched analog of report_task_result."""
+        for entry in request.results:
+            self._task_d.report(
+                entry.task_id, not entry.err_message, entry.err_message
+            )
+        return pb.Empty()
+
+    def get_world_hint(self, request, context):
+        """The announced next worker world (policy scale events); workers
+        poll this so the AOT speculator compiles the announced world."""
+        self._touch(request.worker_id)
+        if self._world_hints is None:
+            return pb.WorldHintResponse()
+        hint = self._world_hints.current()
+        return pb.WorldHintResponse(
+            hint_seq=hint["hint_seq"],
+            target_world_size=hint["target_world_size"],
+            reason=hint["reason"],
+            age_seconds=hint["age_seconds"],
+        )
 
     def report_evaluation_metrics(self, request, context):
         self._touch(request.worker_id)
@@ -210,6 +258,14 @@ class MasterServicer:
             # aggregator, so `edl top` sees anomalies without scraping.
             res.stragglers.extend(self._aggregator.stragglers())
             res.alerts_fired = self._aggregator.alerts_fired()
+        # Policy plane: applied actions, active blacklists, backup races.
+        res.policy_blacklisted.extend(
+            f"worker-{wid}" for wid in stats.get("blacklisted", [])
+        )
+        res.backup_tasks_inflight = stats.get("backups_inflight", 0)
+        res.backup_wins = stats.get("backup_wins", 0)
+        if self._policy is not None:
+            res.policy_actions = self._policy.actions_total()
         for wid, age in last_seen_ago.items():
             res.worker_last_seen_ago[wid] = age
         for wid, n in stats["doing_by_worker"].items():

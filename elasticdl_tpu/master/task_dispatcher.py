@@ -43,6 +43,15 @@ _ABANDONED = _REG.counter(
     "edl_tasks_abandoned_total",
     "Tasks dropped after exhausting max_task_retries (fails the job)",
 )
+_BACKUPS = _REG.counter(
+    "edl_backup_tasks_total",
+    "Speculative backup task copies, by lifecycle outcome",
+    labelnames=("outcome",),
+)
+_BLACKLISTED = _REG.gauge(
+    "edl_workers_blacklisted",
+    "Workers currently blacklisted by the dispatcher (no new tasks)",
+)
 _TODO = _REG.gauge("edl_tasks_todo", "Tasks waiting for dispatch")
 _DOING = _REG.gauge("edl_tasks_doing", "Tasks currently in flight")
 _RECORDS = _REG.gauge(
@@ -138,6 +147,18 @@ class TaskDispatcher:
         self._tasks_abandoned = 0  # retry-exhausted drops, ditto
         self._eval_complete_callbacks = []
         self._tasks_done_callbacks = []
+        # Policy plane: blacklist + speculative backup copies.
+        self._blacklist = {}  # worker_id -> (expires_at, reason)
+        self._backup_queue = collections.deque()  # primary ids needing a copy
+        self._twins = {}  # task_id <-> twin task_id (both directions)
+        self._backup_ids = set()  # ids in _doing that are backup copies
+        # Copies retired because their twin won the race: the loser's late
+        # report is acknowledged-but-discarded instead of warned about.
+        # Entries leave on use or with the job, and the set is bounded by
+        # the backup rate limit — at most one per launched backup.
+        self._retired_twins = set()
+        self._backups_launched = 0
+        self._backup_wins = 0
 
         if self._training_shards:
             logger.info("Starting epoch 0")
@@ -296,6 +317,11 @@ class TaskDispatcher:
         t0 = time.perf_counter()
         try:
             with self._lock:
+                if self._blacklisted_locked(worker_id):
+                    return -1, None
+                backup = self._serve_backup_locked(worker_id)
+                if backup is not None:
+                    return backup
                 self._roll_epoch_locked(not self._todo)
                 if not self._todo:
                     return -1, None
@@ -311,6 +337,17 @@ class TaskDispatcher:
                 time.perf_counter() - t0
             )
 
+    def get_batch(self, worker_id, max_tasks):
+        """Lease up to max_tasks tasks in one call: [(task_id, _Task)].
+        Shares get()'s blacklist/backup/epoch semantics per popped task."""
+        tasks = []
+        for _ in range(max(1, max_tasks)):
+            task_id, task = self.get(worker_id)
+            if task_id < 0:
+                break
+            tasks.append((task_id, task))
+        return tasks
+
     def get_eval_task(self, worker_id):
         """Pop the first EVALUATION task only (reference
         task_dispatcher.py:272-297)."""
@@ -324,7 +361,12 @@ class TaskDispatcher:
         t0 = time.perf_counter()
         try:
             with self._lock:
+                if self._blacklisted_locked(worker_id):
+                    return -1, None
                 if task_type == pb.TRAINING:
+                    backup = self._serve_backup_locked(worker_id)
+                    if backup is not None:
+                        return backup
                     self._roll_epoch_locked(
                         not any(t.type == pb.TRAINING for t in self._todo)
                     )
@@ -347,6 +389,175 @@ class TaskDispatcher:
                 time.perf_counter() - t0
             )
 
+    # ---------- policy plane: blacklist + speculative backups ----------
+
+    def _blacklisted_locked(self, worker_id, now=None):
+        entry = self._blacklist.get(worker_id)
+        if entry is None:
+            return False
+        expires_at, _ = entry
+        if (now or time.time()) >= expires_at:
+            # TTL expiry re-admits the worker even if its relaunch never
+            # completed — the self-healing default.
+            del self._blacklist[worker_id]
+            _BLACKLISTED.set(len(self._blacklist))
+            return False
+        return True
+
+    def blacklist_worker(self, worker_id, ttl_seconds, reason=""):
+        """No new task routes to this worker until the TTL expires or
+        unblacklist_worker is called. In-flight tasks are untouched (the
+        caller decides whether to recover them)."""
+        with self._lock:
+            self._blacklist[worker_id] = (
+                time.time() + max(ttl_seconds, 0.0), reason
+            )
+            _BLACKLISTED.set(len(self._blacklist))
+        emit_event(
+            "worker_blacklist",
+            worker=worker_id,
+            ttl_seconds=round(ttl_seconds, 1),
+            reason=reason[:200],
+        )
+        logger.info(
+            "Blacklisted worker %d for %.0fs (%s)",
+            worker_id, ttl_seconds, reason,
+        )
+
+    def unblacklist_worker(self, worker_id):
+        with self._lock:
+            removed = self._blacklist.pop(worker_id, None) is not None
+            _BLACKLISTED.set(len(self._blacklist))
+        if removed:
+            emit_event("worker_blacklist", worker=worker_id, cleared=True)
+        return removed
+
+    def blacklisted_workers(self):
+        """Currently blacklisted worker ids (expired entries dropped)."""
+        now = time.time()
+        with self._lock:
+            return sorted(
+                wid for wid in list(self._blacklist)
+                if self._blacklisted_locked(wid, now)
+            )
+
+    def backup_candidates(self, factor=3.0, min_samples=5, limit=1):
+        """In-flight TRAINING tasks running > factor x the rolling mean
+        completion time with no backup copy yet, slowest first:
+        [(task_id, worker_id, elapsed_seconds)]."""
+        now = time.time()
+        with self._lock:
+            durations = self._task_durations.get(pb.TRAINING, [])
+            if len(durations) < min_samples:
+                return []
+            mean = max(sum(durations) / len(durations), 1e-3)
+            queued = set(self._backup_queue)
+            out = []
+            for tid, (wid, task, start) in self._doing.items():
+                if task.type != pb.TRAINING:
+                    continue
+                if tid in self._twins or tid in self._backup_ids:
+                    continue
+                if tid in queued:
+                    continue
+                elapsed = now - start
+                if elapsed > factor * mean:
+                    out.append((tid, wid, elapsed))
+            out.sort(key=lambda item: -item[2])
+            return out[:limit]
+
+    def request_backup(self, task_id):
+        """Queue a speculative second copy of an in-flight TRAINING task.
+        The copy goes to the next eligible worker that asks for work (never
+        the primary's owner); first result wins, the loser's late report is
+        acknowledged and discarded, records_done counts once."""
+        with self._lock:
+            entry = self._doing.get(task_id)
+            if (
+                entry is None
+                or entry[1].type != pb.TRAINING
+                or task_id in self._twins
+                or task_id in self._backup_ids
+                or task_id in self._backup_queue
+            ):
+                return False
+            self._backup_queue.append(task_id)
+        _BACKUPS.labels(outcome="requested").inc()
+        emit_event("backup_task", task_id=task_id, phase="requested")
+        return True
+
+    def _serve_backup_locked(self, worker_id):
+        """Hand a queued backup copy to worker_id if one is eligible (the
+        primary is still in flight and owned by someone else). Returns
+        (backup_task_id, _Task) or None."""
+        for _ in range(len(self._backup_queue)):
+            primary_id = self._backup_queue.popleft()
+            entry = self._doing.get(primary_id)
+            if entry is None or primary_id in self._twins:
+                continue  # primary resolved (or raced) while queued
+            owner_id, task, _ = entry
+            if owner_id == worker_id:
+                # Never give the straggler its own backup; retry later.
+                self._backup_queue.append(primary_id)
+                continue
+            backup_id = self._next_task_id
+            self._next_task_id += 1
+            self._doing[backup_id] = (worker_id, task, time.time())
+            self._twins[primary_id] = backup_id
+            self._twins[backup_id] = primary_id
+            self._backup_ids.add(backup_id)
+            self._backups_launched += 1
+            _DISPATCHED.labels(type=_type_name(task.type)).inc()
+            _BACKUPS.labels(outcome="dispatched").inc()
+            self._gauges_locked()
+            emit_event(
+                "backup_task",
+                task_id=primary_id,
+                backup_id=backup_id,
+                phase="dispatched",
+                worker=worker_id,
+                primary_worker=owner_id,
+            )
+            return backup_id, task
+        return None
+
+    def _resolve_twin_locked(self, task_id, success):
+        """First-result-wins bookkeeping for a reported copy of a twinned
+        task. Returns "win" (count this report's records), "lone_failure"
+        (no live twin: run the normal retry ladder), or "copy_failed"
+        (this copy failed but its twin is still racing: discard)."""
+        twin_id = self._twins.pop(task_id, None)
+        if twin_id is None:
+            return "win" if success else "lone_failure"
+        self._twins.pop(twin_id, None)
+        if success:
+            # Retire the losing copy: its in-flight entry leaves _doing
+            # now and its eventual late report is ack-and-discard.
+            if self._doing.pop(twin_id, None) is not None:
+                self._retired_twins.add(twin_id)
+                self._backup_ids.discard(twin_id)
+            self._backup_wins += 1
+            outcome = (
+                "backup_win" if task_id in self._backup_ids
+                else "primary_win"
+            )
+            _BACKUPS.labels(outcome=outcome).inc()
+            emit_event(
+                "backup_task",
+                task_id=task_id,
+                twin=twin_id,
+                phase=outcome,
+            )
+            return "win"
+        # This copy failed but the twin is still running: the twin owns
+        # the work now (requeueing here would triple-run the range).
+        _BACKUPS.labels(outcome="copy_failed").inc()
+        emit_event(
+            "backup_task", task_id=task_id, twin=twin_id,
+            phase="copy_failed",
+        )
+        return "copy_failed"
+
     def report(self, task_id, success, err_message=""):
         """Worker finished (or failed) a task. Failed tasks are re-queued at
         the front until retries are exhausted, which fails the job."""
@@ -362,9 +573,26 @@ class TaskDispatcher:
         with self._lock:
             entry = self._doing.pop(task_id, None)
             if entry is None:
+                if task_id in self._retired_twins:
+                    # The loser of a backup race reporting late: its twin
+                    # already won and took the accounting. Acknowledge and
+                    # discard — records_done must never double-count.
+                    self._retired_twins.discard(task_id)
+                    _REPORTED.labels(result="duplicate").inc()
+                    emit_event(
+                        "backup_task", task_id=task_id,
+                        phase="late_duplicate",
+                    )
+                    return None
                 logger.warning("Unknown task id reported: %d", task_id)
                 return None
             worker_id, task, start_time = entry
+            verdict = self._resolve_twin_locked(task_id, success)
+            self._backup_ids.discard(task_id)
+            if verdict == "copy_failed":
+                # Failed copy of a still-racing twin: no retry ladder.
+                self._gauges_locked()
+                return task
             if success:
                 _REPORTED.labels(result="success").inc()
                 self._task_durations.setdefault(
@@ -440,6 +668,8 @@ class TaskDispatcher:
             ]
             for tid in ids:
                 _, task, _ = self._doing.pop(tid)
+                if self._drop_copy_if_twinned_locked(tid):
+                    continue
                 if self._stop_training and task.type == pb.TRAINING:
                     continue
                 task.retry_count += 1
@@ -473,6 +703,22 @@ class TaskDispatcher:
                 err_message,
             )
 
+    def _drop_copy_if_twinned_locked(self, tid):
+        """A popped in-flight task copy turned out to be half of a backup
+        twin pair. Break the links; True when the OTHER copy is still in
+        flight (so this one is simply dropped, not requeued)."""
+        twin_id = self._twins.pop(tid, None)
+        if twin_id is None:
+            return False
+        self._twins.pop(twin_id, None)
+        self._backup_ids.discard(tid)
+        _BACKUPS.labels(outcome="copy_recovered").inc()
+        emit_event(
+            "backup_task", task_id=tid, twin=twin_id,
+            phase="copy_recovered",
+        )
+        return twin_id in self._doing
+
     def _abandon_locked(self, task, task_id, worker_id, err_message):
         """A task's retry ladder is exhausted: count it LOUDLY (elasticity
         event + counter + job-status field) and fail the job. A silently
@@ -502,23 +748,29 @@ class TaskDispatcher:
                 for tid, (wid, _, _) in self._doing.items()
                 if wid == worker_id
             ]
+            requeued = 0
             for tid in ids:
                 _, task, _ = self._doing.pop(tid)
+                if self._drop_copy_if_twinned_locked(tid):
+                    # A copy of a still-racing twin dies with its worker:
+                    # the surviving copy owns the work, nothing to requeue.
+                    continue
                 if self._stop_training and task.type == pb.TRAINING:
                     continue
                 self._todo.appendleft(task)
-            self._tasks_recovered += len(ids)
+                requeued += 1
+            self._tasks_recovered += requeued
             self._gauges_locked()
-        if ids:
-            _RECOVERED.inc(len(ids))
+        if requeued:
+            _RECOVERED.inc(requeued)
             emit_event(
                 "task_reassign",
                 worker=worker_id,
-                count=len(ids),
+                count=requeued,
                 task_ids=ids[:32],
             )
             logger.info(
-                "Recovered %d tasks from worker %d", len(ids), worker_id
+                "Recovered %d tasks from worker %d", requeued, worker_id
             )
 
     # ---------- status ----------
@@ -613,14 +865,26 @@ class TaskDispatcher:
             doing_by_worker = {}
             for wid, _, _ in self._doing.values():
                 doing_by_worker[wid] = doing_by_worker.get(wid, 0) + 1
+            now = time.time()
+            blacklisted = sorted(
+                wid for wid in list(self._blacklist)
+                if self._blacklisted_locked(wid, now)
+            )
             return {
                 "todo": len(self._todo),
                 "doing": len(self._doing),
                 "doing_by_worker": doing_by_worker,
                 "epoch": self._epoch,
                 "num_epochs": self._num_epochs,
+                "epoch_records": sum(
+                    n for _, n in self._training_shards.values()
+                ),
                 "records_done": self._records_done,
                 "tasks_recovered": self._tasks_recovered,
                 "tasks_abandoned": self._tasks_abandoned,
                 "job_failed": self._job_failed,
+                "blacklisted": blacklisted,
+                "backups_inflight": len(self._backup_ids),
+                "backups_launched": self._backups_launched,
+                "backup_wins": self._backup_wins,
             }

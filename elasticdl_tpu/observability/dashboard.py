@@ -197,6 +197,42 @@ def render(summary, status=None, width=None, top=0):
                 f"{bar(totals[role], scale)}{ratio_txt}"
             )
 
+    policy = summary.get("policy") or {}
+    if policy.get("enabled"):
+        lines.append("")
+        head = (
+            f"policy actions={_int(policy.get('actions_total'))} "
+            f"ticks={_int(policy.get('ticks'))}"
+        )
+        if policy.get("dry_run"):
+            head += "  DRY-RUN"
+        blacklisted = policy.get("blacklisted") or []
+        if blacklisted:
+            head += (
+                "  blacklist="
+                + ",".join(str(w) for w in blacklisted)
+            )
+        if policy.get("backups_inflight"):
+            head += f"  backups={_int(policy.get('backups_inflight'))}"
+        if policy.get("backup_wins"):
+            head += f"  backup_wins={_int(policy.get('backup_wins'))}"
+        hint = policy.get("world_hint") or {}
+        if hint.get("seq"):
+            head += (
+                f"  hint=world {hint.get('target_world_size')}"
+                f" ({_fmt_seconds(hint.get('age_seconds'))} ago)"
+            )
+        lines.append(head)
+        now_ts = summary.get("ts")
+        for d in (policy.get("recent") or [])[-4:]:
+            age_txt = ""
+            if now_ts is not None and d.get("ts") is not None:
+                age_txt = f" {_fmt_seconds(max(0, now_ts - d['ts']))} ago"
+            lines.append(
+                f"  {d.get('action')}[{d.get('subject')}] "
+                f"{d.get('outcome')}{age_txt}: {d.get('reason')}"
+            )
+
     alerts = summary.get("alerts") or []
     lines.append("")
     if alerts:

@@ -313,6 +313,16 @@ class AllReduceTrainer(JaxTrainer):
         # both from the live backend / world size).
         self._topo_override = None
         self._topo_candidates = None
+        # Master-announced next world (policy scale events): polled from
+        # get_world_hint, consumed as the FIRST speculation candidate so
+        # the regroup that follows a policy scale finds its executable
+        # prebuilt. 0 = no hint ever seen; poll interval 0 disables.
+        self._hint_poll_s = knobs.get_float(
+            "ELASTICDL_POLICY_HINT_POLL_SECONDS"
+        )
+        self._last_hint_poll = 0.0
+        self._hint_seq_seen = 0
+        self._hinted_world = 0
         self._speculated = set()  # (fingerprint, real_n) already queued
         self._last_batch_abstract = None  # (feat_abs, label_abs, real_n)
         self._speculator = SpeculativeWorldCompiler(self.plan_step_for_spec)
@@ -1290,6 +1300,7 @@ class AllReduceTrainer(JaxTrainer):
             return
         if self._world_spec is None or self._last_batch_abstract is None:
             return
+        self._poll_world_hint()
         real_n = self._last_batch_abstract[2]
         current = self._world_spec.fingerprint()
         specs = []
@@ -1317,6 +1328,34 @@ class AllReduceTrainer(JaxTrainer):
         if specs:
             self._speculator.submit(specs, real_n)
 
+    def _poll_world_hint(self):
+        """Throttled get_world_hint poll. A new announcement (hint_seq
+        advanced) records the target world so _candidate_topologies
+        front-loads it — the announced world beats the N±delta guesses."""
+        if self._hint_poll_s <= 0:
+            return
+        now = time.time()
+        if now - self._last_hint_poll < self._hint_poll_s:
+            return
+        self._last_hint_poll = now
+        try:
+            hint = self._mc.get_world_hint()
+        except grpc.RpcError as e:
+            code = e.code() if hasattr(e, "code") else None
+            if code == grpc.StatusCode.UNIMPLEMENTED:
+                # Pre-policy master: stop asking.
+                self._hint_poll_s = 0.0
+            return
+        except Exception:
+            return
+        if hint.hint_seq > self._hint_seq_seen:
+            self._hint_seq_seen = hint.hint_seq
+            self._hinted_world = hint.target_world_size
+            logger.info(
+                "World hint #%d: target world %d (%s)",
+                hint.hint_seq, hint.target_world_size, hint.reason,
+            )
+
     def _candidate_topologies(self):
         if self._topo_candidates is not None:
             return list(self._topo_candidates)
@@ -1327,11 +1366,15 @@ class AllReduceTrainer(JaxTrainer):
             return []
         local = jax.local_device_count()
         out = []
+        hinted = self._hinted_world
+        if hinted >= 1 and hinted != self._world_size:
+            # The master TOLD us the next world; compile it first.
+            out.append(WorldTopology(hinted * local, local, hinted))
         for delta in range(1, world_deltas() + 1):
             for w in (
                 self._world_size - delta, self._world_size + delta
             ):
-                if w >= 1 and w != self._world_size:
+                if w >= 1 and w != self._world_size and w != hinted:
                     out.append(WorldTopology(w * local, local, w))
         return out
 

@@ -32,6 +32,37 @@ class MasterClient:
             )
         )
 
+    def get_task_batch(self, max_tasks, task_type=pb.TRAINING):
+        """Lease up to max_tasks tasks in one RPC (TaskBatch response;
+        empty tasks + finished=False means wait and poll again)."""
+        return self._stub.get_task_batch(
+            pb.GetTaskRequest(
+                worker_id=self._worker_id,
+                task_type=task_type,
+                max_tasks=max_tasks,
+            )
+        )
+
+    def report_task_results(self, results):
+        """Batch-report task results. results: iterable of
+        (task_id, err_message, exec_counters) tuples."""
+        req = pb.ReportTaskResultsRequest()
+        for task_id, err_message, exec_counters in results:
+            entry = req.results.add(
+                task_id=task_id, err_message=err_message or ""
+            )
+            if exec_counters:
+                for k, v in exec_counters.items():
+                    entry.exec_counters[k] = int(v)
+        return self._stub.report_task_results(req)
+
+    def get_world_hint(self):
+        """Poll the master's announced next world (policy scale events);
+        hint_seq == 0 means no hint has ever been announced."""
+        return self._stub.get_world_hint(
+            pb.GetWorldHintRequest(worker_id=self._worker_id)
+        )
+
     def report_task_result(self, task_id, err_message="", exec_counters=None):
         req = pb.ReportTaskResultRequest(
             task_id=task_id, err_message=err_message

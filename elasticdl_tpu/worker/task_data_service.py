@@ -9,6 +9,7 @@ reference needed a pending-task deque for becomes trivial, and a recovered
 task re-runs whole.
 """
 
+import collections
 import time
 
 import grpc
@@ -73,11 +74,23 @@ class TaskDataService:
     def __init__(self, master_client, data_reader):
         self._mc = master_client
         self._reader = data_reader
+        # Lease batching (ELASTICDL_TASK_LEASE_BATCH > 1): amortize the
+        # get/report round-trips over N tasks — leases arrive in one
+        # TaskBatch, completed results accumulate locally and flush as one
+        # batched report before the next lease fetch. The default of 1
+        # keeps the original one-RPC-per-task protocol byte-for-byte.
+        self._lease_batch = max(
+            1, knobs.get_int("ELASTICDL_TASK_LEASE_BATCH")
+        )
+        self._leased = collections.deque()
+        self._pending_reports = []
 
     def get_task(self, task_type=pb.TRAINING, wait=True):
         """Next task from the master; blocks through WAIT states (queue
         momentarily empty) and rides out transient master outages. Returns
         None when the job is finished."""
+        if self._lease_batch > 1 and task_type == pb.TRAINING:
+            return self._get_task_batched(wait)
         while True:
             task = _ride_master_outage(
                 lambda: self._mc.get_task(task_type), "get_task"
@@ -85,6 +98,41 @@ class TaskDataService:
             if task.task_id >= 0:
                 return task
             if task.type == pb.WAIT and wait:
+                time.sleep(_WAIT_SLEEP_SECONDS)
+                continue
+            return None
+
+    def _get_task_batched(self, wait):
+        """Serve from the local lease buffer; refill with one batched RPC
+        (flushing pending result reports first, so the dispatcher's
+        accounting never lags more than one buffer behind)."""
+        while True:
+            if self._leased:
+                return self._leased.popleft()
+            self.flush_reports()
+            try:
+                res = _ride_master_outage(
+                    lambda: self._mc.get_task_batch(self._lease_batch),
+                    "get_task_batch",
+                )
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                if code == grpc.StatusCode.UNIMPLEMENTED:
+                    # Pre-batching master: drop to the single-task
+                    # protocol for the rest of this worker's life.
+                    logger.warning(
+                        "Master lacks get_task_batch; falling back to "
+                        "single-task leases"
+                    )
+                    self._lease_batch = 1
+                    return self.get_task(pb.TRAINING, wait)
+                raise
+            if res.tasks:
+                self._leased.extend(res.tasks)
+                continue
+            if res.finished:
+                return None
+            if wait:
                 time.sleep(_WAIT_SLEEP_SECONDS)
                 continue
             return None
@@ -118,7 +166,21 @@ class TaskDataService:
         get_task does. A report that never lands is SAFE to drop after the
         patience window: the master's watchdog recovers the still-'doing'
         task and re-dispatches it — whereas letting the error propagate
-        kills the worker and turns one control-plane blip into a relaunch."""
+        kills the worker and turns one control-plane blip into a relaunch.
+
+        Under lease batching, successful results buffer locally and flush
+        as one batched RPC (at buffer capacity or before the next lease
+        fetch); failures flush immediately so the master's retry ladder
+        starts without waiting out the buffer."""
+        if self._lease_batch > 1:
+            self._pending_reports.append(
+                (task_id, err_message, exec_counters)
+            )
+            if err_message or (
+                len(self._pending_reports) >= self._lease_batch
+            ):
+                self.flush_reports()
+            return
 
         def dropped(e):
             logger.warning(
@@ -134,6 +196,29 @@ class TaskDataService:
                 task_id, err_message, exec_counters
             ),
             "report_task_result",
+            give_up=dropped,
+        )
+
+    def flush_reports(self):
+        """Send any buffered task results in one batched report. Dropped
+        after the patience window with the same watchdog-recovers
+        semantics as single reports."""
+        if not self._pending_reports:
+            return
+        reports, self._pending_reports = self._pending_reports, []
+
+        def dropped(e):
+            logger.warning(
+                "Dropping %d batched result reports after %.0fs of "
+                "master unreachability; the watchdog will recover and "
+                "re-dispatch them",
+                len(reports),
+                _MASTER_PATIENCE_SECONDS,
+            )
+
+        _ride_master_outage(
+            lambda: self._mc.report_task_results(reports),
+            "report_task_results",
             give_up=dropped,
         )
 

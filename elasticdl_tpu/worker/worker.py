@@ -132,6 +132,9 @@ class Worker:
         while True:
             task = self._tds.get_task()
             if task is None:
+                # Batched leases: results buffered past the last fetch
+                # must land before the loop exits.
+                self._tds.flush_reports()
                 logger.info("Worker %d: no more tasks", self._worker_id)
                 break
             if task.type == pb.TRAINING:

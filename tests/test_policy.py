@@ -1,0 +1,529 @@
+"""Policy-engine unit tests: rules, flap control, actuation ordering, and
+the dispatcher's exactly-once backup accounting — all with injected
+summaries and a fake clock, so every property (hysteresis, cooldown, rate
+limit, dry-run, the no-flap guarantee) is deterministic. The process-level
+counterparts live in tests/test_policy_drill.py."""
+
+import pytest
+
+from elasticdl_tpu.master.policy import (
+    PolicyEngine,
+    WorldHintBoard,
+    policy_enabled,
+)
+
+
+class FakeClock:
+    def __init__(self, start=1000.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+class FakeDispatcher:
+    """Duck-typed actuator surface the engine sees."""
+
+    def __init__(self):
+        self.blacklist_calls = []  # (wid, ttl, reason)
+        self.recover_calls = []
+        self.backup_requests = []
+        self.blacklisted = []
+        self.candidates = []  # (tid, wid, elapsed)
+        self.stats_extra = {}
+
+    def blacklisted_workers(self):
+        return list(self.blacklisted)
+
+    def blacklist_worker(self, wid, ttl_seconds, reason=""):
+        self.blacklist_calls.append((wid, ttl_seconds, reason))
+        self.blacklisted.append(wid)
+
+    def recover_tasks(self, wid):
+        self.recover_calls.append(wid)
+
+    def backup_candidates(self, factor=3.0, min_samples=5, limit=1):
+        return self.candidates[:limit]
+
+    def request_backup(self, tid):
+        self.backup_requests.append(tid)
+        return True
+
+    def stats(self):
+        base = {
+            "todo": 0,
+            "doing": 0,
+            "epoch": 1,
+            "num_epochs": 1,
+            "epoch_records": 0,
+            "records_done": 0,
+            "blacklisted": list(self.blacklisted),
+            "backups_inflight": 0,
+            "backups_launched": 0,
+            "backup_wins": 0,
+        }
+        base.update(self.stats_extra)
+        return base
+
+
+class FakeInstanceManager:
+    def __init__(self, n=2, hints=None):
+        self.n = n
+        self.restarts = []
+        self.scales = []  # (delta, reason, hint_seq_at_call)
+        self.hints = hints
+
+    def worker_count(self):
+        return self.n
+
+    def restart_worker(self, wid, reason=""):
+        self.restarts.append((wid, reason))
+
+    def scale_workers(self, delta, reason=""):
+        seq = self.hints.current()["hint_seq"] if self.hints else None
+        self.scales.append((delta, reason, seq))
+        self.n += delta
+
+
+def _engine(dispatcher, clock, summary, im=None, hints=None, **kw):
+    kw.setdefault("interval", 3600)  # never self-ticks; tests drive tick()
+    kw.setdefault("dry_run", False)
+    kw.setdefault("hysteresis", 2)
+    kw.setdefault("cooldown_seconds", 30)
+    kw.setdefault("rate_limit", 6)
+    kw.setdefault("deadline_seconds", 0)
+    return PolicyEngine(
+        lambda: summary(), dispatcher, instance_manager=im,
+        world_hints=hints, time_fn=clock, **kw,
+    )
+
+
+def _healthy_summary():
+    return {
+        "records_per_second": 100.0,
+        "workers": {
+            "worker-0": {"straggler_score": 1.0},
+            "worker-1": {"straggler_score": 1.1},
+        },
+        "tasks": {"eta_seconds": 5.0},
+    }
+
+
+def _straggler_summary(score=9.0):
+    s = _healthy_summary()
+    s["workers"]["worker-0"]["straggler_score"] = score
+    return s
+
+
+# ---------- enable switch ----------
+
+def test_policy_enabled_knob(monkeypatch):
+    monkeypatch.delenv("ELASTICDL_POLICY", raising=False)
+    assert not policy_enabled()
+    for v in ("1", "true", "ON", "yes"):
+        monkeypatch.setenv("ELASTICDL_POLICY", v)
+        assert policy_enabled()
+    monkeypatch.setenv("ELASTICDL_POLICY", "0")
+    assert not policy_enabled()
+
+
+# ---------- no-flap ----------
+
+def test_healthy_fleet_zero_decisions():
+    d = FakeDispatcher()
+    clock = FakeClock()
+    eng = _engine(d, clock, _healthy_summary)
+    for _ in range(50):
+        assert eng.tick() == []
+        clock.advance(1.0)
+    assert eng.actions_total() == 0
+    assert d.blacklist_calls == []
+    assert d.backup_requests == []
+
+
+# ---------- straggler rule ----------
+
+def test_straggler_hysteresis_then_blacklist():
+    d = FakeDispatcher()
+    clock = FakeClock()
+    im = FakeInstanceManager()
+    eng = _engine(d, clock, _straggler_summary, im=im)
+    # First trigger tick: condition holds but hysteresis (2) not met.
+    assert eng.tick() == []
+    clock.advance(1.0)
+    decisions = eng.tick()
+    assert [d_["action"] for d_ in decisions] == ["straggler_blacklist"]
+    assert decisions[0]["outcome"] == "applied"
+    assert decisions[0]["subject"] == "worker-0"
+    assert "straggler_score" in decisions[0]["reason"]
+    # All three mitigation steps ran, and the restart is tied to the
+    # same causal reason.
+    assert [c[0] for c in d.blacklist_calls] == [0]
+    assert d.recover_calls == [0]
+    assert im.restarts and im.restarts[0][0] == 0
+    # Already-blacklisted workers never re-trigger the rule.
+    clock.advance(1.0)
+    assert eng.tick() == []
+    clock.advance(1.0)
+    assert eng.tick() == []
+    assert eng.actions_total() == 1
+
+
+def test_hysteresis_resets_on_healthy_tick():
+    d = FakeDispatcher()
+    clock = FakeClock()
+    summaries = [
+        _straggler_summary(),
+        _healthy_summary(),  # gap: the counter must reset
+        _straggler_summary(),
+        _straggler_summary(),
+    ]
+    eng = _engine(d, clock, lambda: summaries[min(eng._t, 3)], im=None)
+    eng._t = 0
+    for i in range(3):
+        eng._t = i
+        assert eng.tick() == [], f"tick {i} must stay silent"
+        clock.advance(1.0)
+    eng._t = 3
+    decisions = eng.tick()  # second CONSECUTIVE trigger tick
+    assert [x["outcome"] for x in decisions] == ["applied"]
+
+
+def test_dry_run_decides_without_actuating():
+    d = FakeDispatcher()
+    clock = FakeClock()
+    im = FakeInstanceManager()
+    eng = _engine(d, clock, _straggler_summary, im=im, dry_run=True)
+    eng.tick()
+    clock.advance(1.0)
+    decisions = eng.tick()
+    assert [x["outcome"] for x in decisions] == ["dry_run"]
+    assert d.blacklist_calls == []
+    assert d.recover_calls == []
+    assert im.restarts == []
+    # Dry-run decisions are visible but never count as applied actions.
+    assert eng.actions_total() == 0
+
+
+def test_cooldown_suppresses_repeat_action():
+    d = FakeDispatcher()
+    clock = FakeClock()
+    eng = _engine(d, clock, _straggler_summary, cooldown_seconds=30)
+    eng.tick()
+    clock.advance(1.0)
+    assert eng.tick()[0]["outcome"] == "applied"
+    # The worker comes back (blacklist cleared) but is still slow: the
+    # next decision for the same (action, subject) hits the cooldown.
+    d.blacklisted = []
+    for _ in range(2):
+        clock.advance(1.0)
+        decisions = eng.tick()
+    assert decisions[0]["outcome"] == "cooldown"
+    assert eng.actions_total() == 1
+    # A decision (even suppressed) restarts hysteresis; past the
+    # cooldown the rule re-earns its trigger and applies again.
+    d.blacklisted = []
+    clock.advance(40.0)
+    eng.tick()
+    clock.advance(1.0)
+    decisions = eng.tick()
+    assert decisions[0]["outcome"] == "applied"
+    assert eng.actions_total() == 2
+
+
+def test_rate_limit_caps_applied_actions():
+    d = FakeDispatcher()
+    clock = FakeClock()
+
+    def summary():
+        return {
+            "workers": {
+                "worker-0": {"straggler_score": 9.0},
+                "worker-1": {"straggler_score": 9.0},
+            },
+        }
+
+    eng = _engine(d, clock, summary, rate_limit=1, cooldown_seconds=0)
+    eng.tick()
+    clock.advance(1.0)
+    decisions = eng.tick()
+    outcomes = sorted(x["outcome"] for x in decisions)
+    assert outcomes == ["applied", "rate_limited"]
+    assert eng.actions_total() == 1
+    # The sliding window drains: a minute later the next action admits.
+    d.blacklisted = []
+    clock.advance(90.0)
+    eng.tick()
+    clock.advance(1.0)
+    assert any(x["outcome"] == "applied" for x in eng.tick())
+
+
+# ---------- backup rule ----------
+
+def test_backup_rule_requests_copy_after_hold(monkeypatch):
+    monkeypatch.setenv("ELASTICDL_POLICY_MAX_BACKUPS", "2")
+    d = FakeDispatcher()
+    d.candidates = [(7, 0, 12.0)]
+    clock = FakeClock()
+    eng = _engine(d, clock, _healthy_summary)
+    assert eng.tick() == []
+    clock.advance(1.0)
+    decisions = eng.tick()
+    assert [x["action"] for x in decisions] == ["backup_task"]
+    assert decisions[0]["subject"] == "task-7"
+    assert d.backup_requests == [7]
+
+
+def test_backup_rule_respects_inflight_budget(monkeypatch):
+    monkeypatch.setenv("ELASTICDL_POLICY_MAX_BACKUPS", "1")
+    d = FakeDispatcher()
+    d.candidates = [(7, 0, 12.0)]
+    d.stats_extra = {"backups_inflight": 1}
+    clock = FakeClock()
+    eng = _engine(d, clock, _healthy_summary)
+    for _ in range(4):
+        assert eng.tick() == []
+        clock.advance(1.0)
+    assert d.backup_requests == []
+
+
+# ---------- deadline rule ----------
+
+def _deadline_setup(monkeypatch, rps=100.0, records_done=0,
+                    total_records=100_000, n=2, deadline=60.0):
+    monkeypatch.setenv("ELASTICDL_POLICY_MAX_WORKERS", "4")
+    d = FakeDispatcher()
+    d.stats_extra = {
+        "epoch_records": total_records,
+        "num_epochs": 1,
+        "records_done": records_done,
+    }
+    clock = FakeClock()
+    hints = WorldHintBoard(time_fn=clock)
+    im = FakeInstanceManager(n=n, hints=hints)
+
+    def summary():
+        return {"records_per_second": rps, "workers": {}, "tasks": {}}
+
+    eng = _engine(
+        d, clock, summary, im=im, hints=hints, deadline_seconds=deadline
+    )
+    return eng, im, hints, clock
+
+
+def test_deadline_overshoot_scales_up_announce_first(monkeypatch):
+    # ETA 1000s vs 60s deadline: hopelessly behind.
+    eng, im, hints, clock = _deadline_setup(monkeypatch)
+    eng.tick()
+    clock.advance(1.0)
+    decisions = eng.tick()
+    assert [x["action"] for x in decisions] == ["scale_up"]
+    assert decisions[0]["outcome"] == "applied"
+    assert "overshoots" in decisions[0]["reason"]
+    # The world-hint RPC contract: the target world was ANNOUNCED before
+    # the instance manager actuated (hint_seq already 1 at the call).
+    assert im.scales == [(1, decisions[0]["reason"], 1)]
+    hint = hints.current()
+    assert hint["hint_seq"] == 1
+    assert hint["target_world_size"] == 3
+
+
+def test_deadline_ahead_scales_back_down(monkeypatch):
+    # ETA 10s vs 10000s remaining — way ahead; fleet grew to 4 earlier,
+    # initial was 4 at construction... use a fresh engine whose initial
+    # count is 2 but current count is 4.
+    monkeypatch.setenv("ELASTICDL_POLICY_MAX_WORKERS", "4")
+    d = FakeDispatcher()
+    d.stats_extra = {
+        "epoch_records": 1000,
+        "num_epochs": 1,
+        "records_done": 0,
+    }
+    clock = FakeClock()
+    hints = WorldHintBoard(time_fn=clock)
+    im = FakeInstanceManager(n=2, hints=hints)
+
+    def summary():
+        return {"records_per_second": 100.0, "workers": {}, "tasks": {}}
+
+    eng = _engine(
+        d, clock, summary, im=im, hints=hints, deadline_seconds=10_000
+    )
+    im.n = 4  # the fleet was scaled up since the engine started
+    eng.tick()
+    clock.advance(1.0)
+    decisions = eng.tick()
+    assert [x["action"] for x in decisions] == ["scale_down"]
+    assert im.scales[-1][0] == -1
+    assert hints.current()["target_world_size"] == 3
+    # Never below the initial world.
+    im.n = 2
+    clock.advance(60.0)
+    eng.tick()
+    clock.advance(1.0)
+    assert eng.tick() == []
+
+
+def test_deadline_capped_by_max_workers(monkeypatch):
+    eng, im, hints, clock = _deadline_setup(monkeypatch, n=4)
+    for _ in range(4):
+        assert eng.tick() == []
+        clock.advance(1.0)
+    assert im.scales == []
+
+
+def test_job_eta_is_job_wide_not_epoch_scoped(monkeypatch):
+    """The dispatcher regenerates tasks lazily per epoch, so queue-based
+    ETA is epoch-scoped; the policy's ETA must cover the whole plan."""
+    d = FakeDispatcher()
+    d.stats_extra = {
+        "epoch_records": 256,
+        "num_epochs": 400,
+        "records_done": 25_600,  # 25 epochs in
+    }
+    clock = FakeClock()
+    eng = _engine(d, clock, lambda: {})
+    eta = eng._job_eta({
+        "records_per_second": 1000.0,
+        "tasks": {"eta_seconds": 0.1},  # the misleading epoch-tail ETA
+    })
+    assert eta == pytest.approx((256 * 400 - 25_600) / 1000.0)
+    # Without a records plan (evaluation-only), fall back to the
+    # aggregator's queue ETA.
+    d.stats_extra = {"epoch_records": 0, "num_epochs": 0}
+    assert eng._job_eta({"tasks": {"eta_seconds": 7.5}}) == 7.5
+
+
+# ---------- world-hint board ----------
+
+def test_world_hint_board_monotonic():
+    clock = FakeClock()
+    b = WorldHintBoard(time_fn=clock)
+    assert b.current() == {
+        "hint_seq": 0, "target_world_size": 0, "reason": "",
+        "age_seconds": 0.0,
+    }
+    assert b.announce(3, "grow") == 1
+    clock.advance(2.0)
+    cur = b.current()
+    assert cur["hint_seq"] == 1
+    assert cur["target_world_size"] == 3
+    assert cur["age_seconds"] == pytest.approx(2.0)
+    assert b.announce(2, "shrink") == 2
+    assert b.current()["target_world_size"] == 2
+
+
+# ---------- engine summary / dashboard ----------
+
+def test_engine_summary_and_dashboard_render():
+    d = FakeDispatcher()
+    clock = FakeClock()
+    hints = WorldHintBoard(time_fn=clock)
+    im = FakeInstanceManager(n=2, hints=hints)
+    eng = _engine(d, clock, _straggler_summary, im=im, hints=hints)
+    eng.tick()
+    clock.advance(1.0)
+    eng.tick()
+    hints.announce(3, "grow")
+    ps = eng.summary()
+    assert ps["enabled"] is True
+    assert ps["actions_total"] == 1
+    assert ps["blacklisted"] == ["worker-0"]
+    assert ps["recent"][-1]["action"] == "straggler_blacklist"
+    assert ps["world_hint"]["target_world_size"] == 3
+
+    from elasticdl_tpu.observability import dashboard
+
+    frame = dashboard.render(
+        {"job": "j", "ts": clock.now, "policy": ps}, width=120
+    )
+    assert "policy actions=1" in frame
+    assert "blacklist=worker-0" in frame
+    assert "straggler_blacklist[worker-0] applied" in frame
+    assert "hint=world 3" in frame
+
+
+# ---------- dispatcher exactly-once backup accounting ----------
+
+def _dispatcher():
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+    return TaskDispatcher(
+        {"shard": (0, 64)}, records_per_task=16, num_epochs=1,
+        shuffle=False,
+    )
+
+
+def test_backup_primary_wins_then_loser_discarded():
+    td = _dispatcher()
+    tid, task = td.get(worker_id=0)
+    assert td.request_backup(tid)
+    bid, btask = td.get(worker_id=1)  # the speculative copy
+    assert bid != tid and (btask.start, btask.end) == (task.start, task.end)
+    assert td.stats()["backups_inflight"] == 1
+    # Primary reports first: its records count, the copy is retired.
+    td.report(tid, True)
+    s = td.stats()
+    assert s["records_done"] == 16
+    assert s["backup_wins"] == 1
+    assert s["backups_inflight"] == 0
+    # The loser's late report: acknowledged, discarded, nothing counted.
+    td.report(bid, True)
+    assert td.stats()["records_done"] == 16
+
+
+def test_backup_wins_then_primary_discarded():
+    td = _dispatcher()
+    tid, _ = td.get(worker_id=0)
+    assert td.request_backup(tid)
+    bid, _ = td.get(worker_id=1)
+    # Backup reports first — same invariants, opposite ordering.
+    td.report(bid, True)
+    s = td.stats()
+    assert s["records_done"] == 16
+    assert s["backup_wins"] == 1
+    td.report(tid, True)
+    assert td.stats()["records_done"] == 16
+
+
+def test_backup_copy_failure_leaves_twin_racing():
+    td = _dispatcher()
+    tid, _ = td.get(worker_id=0)
+    td.request_backup(tid)
+    bid, _ = td.get(worker_id=1)
+    # The copy fails: no retry ladder (the primary still owns the work).
+    td.report(bid, False, "copy crashed")
+    s = td.stats()
+    assert s["records_done"] == 0
+    assert s["backup_wins"] == 0
+    # The primary completes normally and counts once.
+    td.report(tid, True)
+    assert td.stats()["records_done"] == 16
+
+
+def test_backup_never_served_to_primary_owner():
+    td = _dispatcher()
+    tid, _ = td.get(worker_id=0)
+    td.request_backup(tid)
+    # The owner asks for work: it must get fresh work, not its own copy.
+    nid, _ = td.get(worker_id=0)
+    assert nid != tid
+    assert td.stats()["backups_inflight"] == 0
+    # A different worker gets the copy.
+    bid, _ = td.get(worker_id=1)
+    assert td.stats()["backups_inflight"] == 1
+
+
+def test_blacklisted_worker_gets_no_tasks():
+    td = _dispatcher()
+    td.blacklist_worker(1, ttl_seconds=300, reason="slow")
+    assert td.get(worker_id=1) == (-1, None)
+    assert td.blacklisted_workers() == [1]
+    tid, _ = td.get(worker_id=0)
+    assert tid >= 0
+    td.unblacklist_worker(1)
+    tid2, _ = td.get(worker_id=1)
+    assert tid2 >= 0

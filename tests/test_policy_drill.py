@@ -1,0 +1,176 @@
+"""Policy-loop drills: the self-healing control loop closed end to end on
+REAL `edl train` jobs. Each scenario injects a fault, then asserts the
+policy engine saw it through the telemetry aggregator, decided (a
+`policy_decision` event with a causal reason), actuated, and the job
+RECOVERED — throughput back, backup won, or world grown — not merely that
+a flag flipped. docs/POLICY.md catalogs the scenarios."""
+
+import os
+import sys
+
+import pytest
+
+import test_module
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from elastic_drill import run_drill  # noqa: E402
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+
+def _write_data(tmp_path, n=256):
+    from elasticdl_tpu.data.recordfile import RecordFileWriter
+
+    data = str(tmp_path / "linear.edlr")
+    with RecordFileWriter(data) as w:
+        for r in test_module.make_linear_records(n):
+            w.write(r)
+    return data
+
+
+def _events(obs_dir):
+    from elasticdl_tpu.observability.events import read_events
+
+    return read_events(os.path.join(obs_dir, "events.jsonl"))
+
+
+def test_straggler_recovery_drill(tmp_path):
+    """A worker turns persistently slow mid-job: the policy must
+    blacklist it (decision trail in events.jsonl), the dispatcher must
+    recover its tasks, and records/s must RETURN to within tolerance of
+    the healthy pre-fault baseline."""
+    data = _write_data(tmp_path)
+    obs_dir = str(tmp_path / "obs")
+    result = run_drill(
+        data,
+        model_zoo=os.path.join(REPO, "tests"),
+        model_def="test_module",
+        num_workers=2,
+        num_ps=1,
+        num_epochs=200,
+        scenario="straggler-recovery",
+        obs_dir=obs_dir,
+        env_overrides={"JAX_PLATFORMS": "cpu"},
+        timeout=300,
+    )
+    assert result["completed"], result.get("log_tail", "")[-1500:]
+    decision = result["decision"]
+    assert decision is not None, result.get("decision_trail")
+    # The decision carries its cause: the straggler score that crossed
+    # the threshold, attributed to the slow worker.
+    assert decision["action"] == "straggler_blacklist"
+    assert decision["subject"] == "worker-0"
+    assert decision["outcome"] == "applied"
+    assert "straggler_score" in decision["reason"]
+    # Recovery is MEASURED: throughput back within tolerance of the
+    # pre-fault baseline (or the job drained — also a recovery).
+    assert result["baseline_rps"], result
+    assert result["recovered"], (
+        f"throughput never recovered: baseline={result['baseline_rps']} "
+        f"recovered={result['recovered_rps']}\n"
+        f"{result.get('log_tail', '')[-1500:]}"
+    )
+    # The causal chain in the shared event log: the policy decision, then
+    # the blacklisted worker's forgiven restart (pod_exit -> relaunch
+    # already asserted by the elasticity drills; here the DECISION must
+    # precede the recovery the master logs).
+    records = _events(obs_dir)
+    kinds = [r["kind"] for r in records]
+    assert "policy_decision" in kinds
+    assert result["recovered_tasks"], result.get("log_tail", "")[-1000:]
+
+
+def test_backup_task_drill(tmp_path):
+    """A worker freezes while provably owning a task: the backup rule
+    must dispatch a speculative copy, the copy must WIN, and the thawed
+    loser's late report must be ack-discarded — records_done exact, no
+    double count (exactly-once)."""
+    data = _write_data(tmp_path)
+    obs_dir = str(tmp_path / "obs")
+    epochs = 200
+    result = run_drill(
+        data,
+        model_zoo=os.path.join(REPO, "tests"),
+        model_def="test_module",
+        num_workers=2,
+        num_ps=1,
+        num_epochs=epochs,
+        scenario="backup-task",
+        obs_dir=obs_dir,
+        env_overrides={"JAX_PLATFORMS": "cpu"},
+        timeout=300,
+    )
+    assert result["completed"], result.get("log_tail", "")[-1500:]
+    assert result["victim_task_observed"], result
+    decision = result["backup_decision"]
+    assert decision is not None, result.get("decision_trail")
+    assert decision["action"] == "backup_task"
+    assert decision["outcome"] == "applied"
+    assert result["backup_wins"] >= 1, result
+    # Exactly-once: the primary's late duplicate must not inflate the
+    # record count — every record counted exactly once despite two
+    # workers having held the same task.
+    assert result["records_done"] == 256 * epochs, result
+
+
+def test_deadline_scale_drill(tmp_path):
+    """Job-wide drain ETA overshoots ELASTICDL_JOB_DEADLINE_SECONDS: the
+    policy must announce the next world FIRST (world_hint event — the
+    speculator's AOT warm-up signal), then actually grow the fleet."""
+    data = _write_data(tmp_path)
+    obs_dir = str(tmp_path / "obs")
+    result = run_drill(
+        data,
+        model_zoo=os.path.join(REPO, "tests"),
+        model_def="test_module",
+        num_workers=2,
+        num_ps=1,
+        num_epochs=400,
+        scenario="deadline-scale",
+        obs_dir=obs_dir,
+        env_overrides={"JAX_PLATFORMS": "cpu"},
+        timeout=300,
+    )
+    assert result["completed"], result.get("log_tail", "")[-1500:]
+    decision = result["scale_decision"]
+    assert decision is not None, result.get("decision_trail")
+    assert decision["action"] == "scale_up"
+    assert decision["outcome"] == "applied"
+    assert "overshoots" in decision["reason"]
+    hint = result["world_hint"]
+    assert hint is not None, "no world_hint event: scale was not announced"
+    assert hint["target_world_size"] > result["workers_at_start"]
+    # Announce-first ordering: the hint lands in the event log BEFORE the
+    # applied decision (workers can only prebuild the announced world if
+    # it is announced before the membership changes).
+    assert hint["seq"] < decision["seq"], (hint, decision)
+    # The world actually grew — actuation, not just intent.
+    assert result["workers_after"] > result["workers_at_start"], result
+
+
+def test_preemption_wave_drill(tmp_path):
+    """A seeded preemption wave SIGKILLs most of the fleet in one sweep;
+    the job must recover every stranded task and finish with exact
+    record accounting."""
+    data = _write_data(tmp_path)
+    epochs = 200
+    result = run_drill(
+        data,
+        model_zoo=os.path.join(REPO, "tests"),
+        model_def="test_module",
+        num_workers=3,
+        num_ps=1,
+        num_epochs=epochs,
+        scenario="preemption-wave",
+        wave_fraction=0.67,
+        env_overrides={"JAX_PLATFORMS": "cpu"},
+        timeout=300,
+    )
+    assert result["completed"], result.get("log_tail", "")[-1500:]
+    assert len(result["wave_killed"]) == 2, result["wave_killed"]
+    assert result["recovered_tasks"], result.get("log_tail", "")[-1000:]
+    assert result["relaunched"], result
+    assert result["records_done"] == 256 * epochs, result
+    assert not result["leftover_procs"], result["leftover_procs"]

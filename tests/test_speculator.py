@@ -242,6 +242,160 @@ def test_in_flight_guess_for_the_kept_world_survives_cancel():
         s.stop()
 
 
+def test_world_hint_polled_and_front_loaded(monkeypatch):
+    """The master announces the next world on the WorldHintBoard; the
+    trainer's throttled get_world_hint poll picks it up over real gRPC
+    and _candidate_topologies compiles the ANNOUNCED world first —
+    before any N±delta guess, and never duplicated by them."""
+    from elasticdl_tpu.master.policy import WorldHintBoard
+
+    monkeypatch.setenv("ELASTICDL_POLICY_HINT_POLL_SECONDS", "0.01")
+    with start_master(
+        training_shards={"f": (0, 100)}, with_membership=True
+    ) as m:
+        board = WorldHintBoard()
+        m["servicer"].bind_job_context(world_hints=board)
+        t, mc = _trainer(m)
+        try:
+            t._poll_world_hint()  # nothing announced yet
+            assert t._hinted_world == 0
+            board.announce(5, "deadline overshoot")
+            time.sleep(0.02)
+            t._poll_world_hint()
+            assert t._hint_seq_seen == 1
+            assert t._hinted_world == 5
+            # Candidate ordering: the hinted world leads, the guesses
+            # skip it.
+            t._multi_host = True
+            t._world_size = 2
+            candidates = t._candidate_topologies()
+            assert candidates[0].n_processes == 5
+            assert [c.n_processes for c in candidates].count(5) == 1
+            # A re-announcement advances the hint; a stale one doesn't.
+            board.announce(3, "scale back")
+            time.sleep(0.02)
+            t._poll_world_hint()
+            assert t._hinted_world == 3
+        finally:
+            t._multi_host = False
+            t.close()
+            mc.close()
+
+
+def test_world_hint_unimplemented_stops_polling():
+    """Pre-policy master without the RPC: the first UNIMPLEMENTED
+    permanently disables hint polling instead of retrying forever."""
+    import grpc
+
+    with start_master(
+        training_shards={"f": (0, 100)}, with_membership=True
+    ) as m:
+        t, mc = _trainer(m)
+        try:
+            class _Unimplemented(grpc.RpcError):
+                def code(self):
+                    return grpc.StatusCode.UNIMPLEMENTED
+
+            def boom():
+                raise _Unimplemented()
+
+            orig = mc.get_world_hint
+            mc.get_world_hint = boom
+            t._poll_world_hint()
+            assert t._hint_poll_s == 0.0
+            # Disabled: later polls never touch the RPC again.
+            mc.get_world_hint = orig
+            t._poll_world_hint()
+            assert t._hint_seq_seen == 0
+        finally:
+            t.close()
+            mc.close()
+
+
+def test_hinted_world_compiled_and_consumed(tmp_path, monkeypatch):
+    """The full world-hint contract: announce -> poll -> speculative AOT
+    of the hinted world (with ZERO guessing budget, so only the hint
+    explains the prebuild) -> the regroup into that world consumes the
+    executable without a synchronous compile, and the event log carries
+    the causal pair (world_hint, then aot_consumed on the hinted
+    spec)."""
+    import jax
+
+    from elasticdl_tpu.master.policy import WorldHintBoard
+    from elasticdl_tpu.observability.events import (
+        EventLog,
+        read_events,
+        set_event_log,
+    )
+
+    monkeypatch.setenv("ELASTICDL_POLICY_HINT_POLL_SECONDS", "0.01")
+    monkeypatch.setenv("ELASTICDL_AOT_WORLDS", "0")
+    events_path = str(tmp_path / "events.jsonl")
+    log = EventLog(events_path, job="hint-test", role="worker-0")
+    set_event_log(log)
+    with start_master(
+        training_shards={"f": (0, 100)}, with_membership=True
+    ) as m:
+        board = WorldHintBoard()
+        m["servicer"].bind_job_context(world_hints=board)
+        t, mc = _trainer(m)
+        try:
+            x, y = _batch(16)
+            t._topo_override = WorldTopology(7, 7, 1)
+            t.train_minibatch(x, y)
+            # The master decides to scale: 8 single-device processes.
+            board.announce(8, "eta overshoots deadline")
+            time.sleep(0.02)
+            # Pose as a rank of a 7-process multi-host world so the
+            # candidate path (hint included) is live; the hinted world
+            # is 8 x 1-device processes, so local_device_count must
+            # read 1 while the candidate resolves.
+            t._multi_host = True
+            t._world_size = 7
+            orig_local = jax.local_device_count
+            jax.local_device_count = lambda: 1
+            try:
+                t._maybe_speculate()
+            finally:
+                jax.local_device_count = orig_local
+                t._multi_host = False
+            assert t._hinted_world == 8
+            assert t._speculator.drain(90), "speculator never idled"
+            # The hinted world is 8 devices across 8 processes, so its
+            # fingerprint carries the process suffix ("data=8|p8").
+            assert any(
+                fp.startswith("data=8") and shape == (16, 16)
+                for fp, shape in t._speculator.prebuilt_keys()
+            ), t._speculator.prebuilt_keys()
+            # Regroup into the ANNOUNCED world: consumed, not compiled.
+            t._topo_override = WorldTopology(8, 1, 8)
+            m["membership"].add_worker_host("10.0.0.2:9999")
+            compiles_before = profiling.tracker().snapshot()[0]
+            t.train_minibatch(x, y)
+            assert dict(t._mesh.shape) == {"data": 8}
+            assert profiling.tracker().snapshot()[0] == compiles_before, (
+                "regroup into the hinted world still compiled"
+            )
+            assert t._speculator.stats["consumed"] == 1
+            # The event log proves causality: the hint precedes the
+            # consumption, and the consumed spec is the live world's.
+            records = read_events(events_path)
+            hint_ev = next(
+                r for r in records if r["kind"] == "world_hint"
+            )
+            consumed_ev = next(
+                r for r in records if r["kind"] == "aot_consumed"
+            )
+            assert hint_ev["target_world_size"] == 8
+            assert hint_ev["seq"] < consumed_ev["seq"]
+            assert consumed_ev["spec"] == t._world_spec.fingerprint()
+        finally:
+            set_event_log(None)
+            log.close()
+            t.close()
+            mc.close()
+
+
 def test_compile_cache_knob_wiring(tmp_path, monkeypatch):
     """ensure_compile_cache: unset knob -> disabled (memoized); the
     instance manager stamps the dir into child env."""

@@ -50,7 +50,33 @@ SCENARIOS = (
     "rpc-brownout",
     "master-stall",
     "straggler",
+    "straggler-recovery",
+    "backup-task",
+    "deadline-scale",
+    "preemption-wave",
 )
+
+# Scenarios that close the loop through the policy engine: they need the
+# master's aggregator (obs_dir) because that is the engine's input.
+POLICY_SCENARIOS = (
+    "straggler-recovery",
+    "backup-task",
+    "deadline-scale",
+)
+
+
+def _policy_env(**overrides):
+    """ELASTICDL_POLICY_* knobs tightened for drill time budgets: 1 s
+    ticks, 2-tick hysteresis, decisions allowed every 10 s."""
+    env = {
+        "ELASTICDL_POLICY": "1",
+        "ELASTICDL_POLICY_INTERVAL": "1.0",
+        "ELASTICDL_POLICY_HYSTERESIS": "2",
+        "ELASTICDL_POLICY_COOLDOWN_SECONDS": "10",
+        "ELASTICDL_AGGREGATOR_INTERVAL": "1.0",
+    }
+    env.update({k: str(v) for k, v in overrides.items()})
+    return env
 
 
 def _free_port():
@@ -159,6 +185,63 @@ def scenario_env(scenario):
             "ELASTICDL_CHAOS": json.dumps(schedule),
             "ELASTICDL_AGGREGATOR_INTERVAL": "1.0",
         }
+    if scenario == "straggler-recovery":
+        # Same role-targeted slowdown as `straggler`, but starting only
+        # after a healthy preamble (the drill measures the pre-fault
+        # throughput baseline there) — and the policy engine is ON: the
+        # master must blacklist the straggler, recover its tasks, and
+        # throughput must RETURN, not just be flagged.
+        schedule = {
+            "seed": 20260807,
+            "rules": [
+                {
+                    "method": "push_gradients",
+                    "kind": "latency",
+                    "latency_s": 0.3,
+                    "start": 30,
+                    "count": -1,
+                    "side": "client",
+                    "role": "worker-0",
+                },
+                {
+                    "method": "pull_dense_parameters",
+                    "kind": "latency",
+                    "latency_s": 0.15,
+                    "start": 30,
+                    "count": -1,
+                    "side": "client",
+                    "role": "worker-0",
+                },
+            ],
+        }
+        env = _policy_env(
+            ELASTICDL_POLICY_STRAGGLER_SCORE="2.5",
+            ELASTICDL_POLICY_BLACKLIST_SECONDS="300",
+            ELASTICDL_POLICY_MAX_BACKUPS="0",
+        )
+        env["ELASTICDL_CHAOS"] = json.dumps(schedule)
+        return env
+    if scenario == "backup-task":
+        # No chaos schedule: the drill SIGSTOPs a worker holding a task;
+        # the backup rule must dispatch a speculative copy and the copy
+        # must win (exactly-once accounting checked via records_done).
+        # The straggler rule is parked so the frozen worker isn't
+        # blacklisted out from under the backup race.
+        return _policy_env(
+            ELASTICDL_POLICY_MAX_BACKUPS="1",
+            ELASTICDL_POLICY_BACKUP_FACTOR="2.5",
+            ELASTICDL_POLICY_STRAGGLER_SCORE="1e9",
+        )
+    if scenario == "deadline-scale":
+        # An ETA that provably overshoots the deadline: the policy must
+        # announce the next world (world_hint) and scale workers up.
+        return _policy_env(
+            ELASTICDL_JOB_DEADLINE_SECONDS="20",
+            ELASTICDL_POLICY_SCALE_STEP="1",
+            ELASTICDL_POLICY_MAX_WORKERS="4",
+            ELASTICDL_POLICY_STRAGGLER_SCORE="1e9",
+            ELASTICDL_POLICY_MAX_BACKUPS="0",
+        )
     if scenario == "master-stall":
         # Shrink the control-plane deadlines below the stall length so the
         # workers' calls fail fast and RETRY through the stall (instead of
@@ -244,6 +327,7 @@ def run_drill(
     scenario="worker-kill",
     obs_dir=None,
     stall_seconds=8.0,
+    wave_fraction=0.5,
 ):
     """strategy: explicit --distribution_strategy name; default derives
     from num_ps (ParameterServerStrategy when PS shards are requested,
@@ -270,6 +354,12 @@ def run_drill(
         raise ValueError(
             "the straggler scenario needs --obs_dir: detection is read "
             "from the master's aggregated /metrics and /api/summary"
+        )
+    if scenario in POLICY_SCENARIOS and not obs_dir:
+        raise ValueError(
+            f"the {scenario} scenario needs --obs_dir: the policy "
+            "engine's input is the master's telemetry aggregator, and "
+            "the decision trail is read from events.jsonl"
         )
     port = _free_port()
     env = dict(os.environ)
@@ -389,6 +479,22 @@ def run_drill(
         elif scenario == "straggler":
             s = _do_straggler_watch(
                 status, s, port, obs_dir, result, timeout, env
+            )
+        elif scenario == "straggler-recovery":
+            s = _do_straggler_recovery(
+                status, s, obs_dir, result, timeout
+            )
+        elif scenario == "backup-task":
+            s = _do_backup_task(
+                status, s, port, obs_dir, result, timeout,
+                chaos_process,
+            )
+        elif scenario == "deadline-scale":
+            s = _do_deadline_scale(status, s, obs_dir, result, timeout)
+        elif scenario == "preemption-wave":
+            result["records_at_kill"] = int(s.records_done)
+            result["wave_killed"] = chaos_process.preemption_wave(
+                num_workers, port, fraction=wave_fraction, seed=20260807
             )
         # rpc-brownout: nothing to do here — the chaos schedule shipped in
         # the environment is already injecting faults.
@@ -539,6 +645,196 @@ def _do_straggler_watch(status, s, port, obs_dir, result, timeout, env):
     return s
 
 
+def _policy_decisions(obs_dir):
+    """All policy_decision events logged so far (the causal trail)."""
+    from elasticdl_tpu.observability.events import read_events
+
+    path = os.path.join(obs_dir, "events.jsonl")
+    if not os.path.exists(path):
+        return []
+    return [
+        r for r in read_events(path)
+        if r.get("kind") == "policy_decision"
+    ]
+
+
+def _find_event(obs_dir, kind):
+    from elasticdl_tpu.observability.events import read_events
+
+    path = os.path.join(obs_dir, "events.jsonl")
+    if not os.path.exists(path):
+        return None
+    for r in read_events(path):
+        if r.get("kind") == kind:
+            return r
+    return None
+
+
+def _find_policy_decision(obs_dir, action, outcome="applied"):
+    for r in _policy_decisions(obs_dir):
+        if r.get("action") == action and r.get("outcome") == outcome:
+            return r
+    return None
+
+
+def _measure_rps(status, seconds):
+    """(records/s over the window, last status). None rps when the master
+    went away mid-window."""
+    s0 = status(time.time() + 10)
+    if s0 is None:
+        return None, None
+    t0 = time.time()
+    time.sleep(seconds)
+    s1 = status(time.time() + 10)
+    if s1 is None:
+        return None, s0
+    dt = max(time.time() - t0, 1e-6)
+    return (int(s1.records_done) - int(s0.records_done)) / dt, s1
+
+
+def _do_straggler_recovery(status, s, obs_dir, result, timeout,
+                           tolerance=0.5, recovery_window=90.0):
+    """The closed loop, end to end: pre-fault baseline -> straggler
+    slows -> policy blacklists + recovers + restarts -> records/s back
+    within `tolerance` of the baseline inside `recovery_window` seconds
+    of the decision. Recovery is measured, not inferred from flags."""
+    # 1. The chaos latency rules burn a per-rule call budget before they
+    #    start; this window is the healthy pre-fault baseline.
+    baseline, s2 = _measure_rps(status, 3.0)
+    if s2 is not None:
+        s = s2
+    result["baseline_rps"] = round(baseline, 2) if baseline else baseline
+    # 2. The decision: an APPLIED straggler_blacklist in events.jsonl.
+    deadline = time.time() + timeout
+    decision = None
+    while time.time() < deadline:
+        decision = _find_policy_decision(obs_dir, "straggler_blacklist")
+        if decision is not None:
+            break
+        s2 = status(time.time() + 10)
+        if s2 is None:
+            break
+        s = s2
+        if s.finished or s.job_failed:
+            break
+        time.sleep(0.5)
+    result["decision"] = decision
+    result["decision_trail"] = _policy_decisions(obs_dir)
+    if decision is None or not baseline:
+        return s
+    # 3. Bounded recovery: throughput back within tolerance, or the job
+    #    drains first (a drained queue IS recovery for a short job).
+    t_decision = time.time()
+    recovered_rps = None
+    while time.time() - t_decision < recovery_window:
+        rps, s2 = _measure_rps(status, 3.0)
+        if s2 is not None:
+            s = s2
+        if s2 is None or s.finished or s.job_failed:
+            break
+        if rps is not None and rps >= tolerance * baseline:
+            recovered_rps = rps
+            result["recovery_s"] = round(time.time() - t_decision, 3)
+            break
+    result["recovered_rps"] = (
+        round(recovered_rps, 2) if recovered_rps else recovered_rps
+    )
+    result["recovered"] = bool(
+        recovered_rps is not None or (s is not None and s.finished)
+    )
+    return s
+
+
+def _do_backup_task(status, s, port, obs_dir, result, timeout,
+                    chaos_process):
+    """Freeze a worker that provably owns an in-flight task (same
+    SIGSTOP gate as worker-kill, but the victim never dies): the backup
+    rule must dispatch a speculative copy, the copy must WIN, and the
+    thawed loser's late report must be discarded without double-counting
+    (checked by the caller via --expect_records)."""
+    victim = chaos_process.find_role_pid("worker", 0, port)
+    freeze_deadline = time.time() + 30
+    try:
+        while True:
+            os.kill(victim, signal.SIGSTOP)
+            time.sleep(0.1)  # drain any in-flight report RPC
+            fresh = status(time.time() + 10)
+            if fresh is not None:
+                s = fresh
+            if (
+                fresh is not None
+                and dict(fresh.worker_doing_tasks).get(0, 0) > 0
+            ):
+                break
+            if fresh is None or time.time() > freeze_deadline:
+                result["victim_task_observed"] = False
+                break
+            os.kill(victim, signal.SIGCONT)
+            time.sleep(0.05)
+    except ProcessLookupError:
+        result["victim_task_observed"] = False
+    result.setdefault("victim_task_observed", True)
+    result["frozen_worker"] = victim
+    # The decision + the win, while the victim stays frozen.
+    deadline = time.time() + timeout
+    decision = None
+    try:
+        while time.time() < deadline:
+            if decision is None:
+                decision = _find_policy_decision(obs_dir, "backup_task")
+            s2 = status(time.time() + 10)
+            if s2 is None:
+                break
+            s = s2
+            if decision is not None and s.backup_wins >= 1:
+                break
+            if s.finished or s.job_failed:
+                break
+            time.sleep(0.5)
+    finally:
+        # Thaw: the loser reports late into the ack-discard path.
+        try:
+            os.kill(victim, signal.SIGCONT)
+        except ProcessLookupError:
+            pass
+    result["backup_decision"] = decision
+    result["decision_trail"] = _policy_decisions(obs_dir)
+    result["backup_wins"] = int(s.backup_wins) if s is not None else 0
+    return s
+
+
+def _do_deadline_scale(status, s, obs_dir, result, timeout):
+    """ETA overshoots ELASTICDL_JOB_DEADLINE_SECONDS: the policy must
+    announce the next world FIRST (world_hint) and then scale up; the
+    drill watches the new worker actually join (alive_workers)."""
+    workers_at_start = int(s.alive_workers)
+    result["workers_at_start"] = workers_at_start
+    deadline = time.time() + timeout
+    decision = None
+    hint = None
+    while time.time() < deadline:
+        if decision is None:
+            decision = _find_policy_decision(obs_dir, "scale_up")
+        if hint is None:
+            hint = _find_event(obs_dir, "world_hint")
+        s2 = status(time.time() + 10)
+        if s2 is None:
+            break
+        s = s2
+        if decision is not None and s.alive_workers > workers_at_start:
+            break
+        if s.finished or s.job_failed:
+            break
+        time.sleep(0.5)
+    result["scale_decision"] = decision
+    result["world_hint"] = hint
+    result["decision_trail"] = _policy_decisions(obs_dir)
+    result["workers_after"] = (
+        int(s.alive_workers) if s is not None else None
+    )
+    return s
+
+
 def _do_worker_kill(train, stub, status, s, port, result,
                     require_victim_task, chaos_process):
     """The original drill: SIGKILL worker 0 (preemption) and measure the
@@ -655,6 +951,12 @@ def main():
     )
     p.add_argument("--stall_seconds", type=float, default=8.0)
     p.add_argument(
+        "--wave_fraction",
+        type=float,
+        default=0.5,
+        help="fraction of workers killed by the preemption-wave scenario",
+    )
+    p.add_argument(
         "--expect_records",
         type=int,
         default=0,
@@ -675,7 +977,8 @@ def main():
             )
         args.num_ps = 0
     obs_dir = args.obs_dir or None
-    if args.scenario == "straggler" and not obs_dir:
+    needs_obs = args.scenario == "straggler" or args.scenario in POLICY_SCENARIOS
+    if needs_obs and not obs_dir:
         import tempfile
 
         obs_dir = tempfile.mkdtemp(prefix="edl_drill_obs_")
@@ -691,13 +994,30 @@ def main():
         scenario=args.scenario,
         obs_dir=obs_dir,
         stall_seconds=args.stall_seconds,
+        wave_fraction=args.wave_fraction,
     )
     result.pop("log_tail", None)
     result.pop("dash_snapshot", None)
-    print(json.dumps(result))
+    print(json.dumps(result, default=str))
     ok = result["completed"] and not result["leftover_procs"]
     if args.scenario == "straggler":
         ok = ok and bool(result.get("straggler_flagged"))
+    elif args.scenario == "straggler-recovery":
+        ok = ok and result.get("decision") is not None
+        ok = ok and bool(result.get("recovered"))
+    elif args.scenario == "backup-task":
+        ok = ok and result.get("backup_decision") is not None
+        ok = ok and result.get("backup_wins", 0) >= 1
+    elif args.scenario == "deadline-scale":
+        ok = ok and result.get("scale_decision") is not None
+        ok = ok and result.get("world_hint") is not None
+        ok = (
+            ok
+            and result.get("workers_after") is not None
+            and result["workers_after"] > result.get("workers_at_start", 0)
+        )
+    elif args.scenario == "preemption-wave":
+        ok = ok and bool(result.get("wave_killed"))
     if args.expect_records:
         ok = ok and result.get("records_done") == args.expect_records
     return 0 if ok else 1

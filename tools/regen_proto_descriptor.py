@@ -42,9 +42,17 @@ NEW_FIELDS = {
         ("tasks_abandoned", 18, F.TYPE_INT64, F.LABEL_OPTIONAL),
         ("stragglers", 19, F.TYPE_STRING, F.LABEL_REPEATED),
         ("alerts_fired", 20, F.TYPE_INT64, F.LABEL_OPTIONAL),
+        # Policy plane (master/policy.py).
+        ("policy_actions", 21, F.TYPE_INT64, F.LABEL_OPTIONAL),
+        ("policy_blacklisted", 22, F.TYPE_STRING, F.LABEL_REPEATED),
+        ("backup_tasks_inflight", 23, F.TYPE_INT32, F.LABEL_OPTIONAL),
+        ("backup_wins", 24, F.TYPE_INT64, F.LABEL_OPTIONAL),
     ],
     "PushGradientsResponse": [
         ("apply_seconds", 3, F.TYPE_FLOAT, F.LABEL_OPTIONAL),
+    ],
+    "GetTaskRequest": [
+        ("max_tasks", 3, F.TYPE_INT32, F.LABEL_OPTIONAL),
     ],
 }
 
@@ -95,6 +103,27 @@ NEW_MESSAGES = {
     "ReportTelemetryResponse": [
         ("accepted", 1, F.TYPE_INT32, F.LABEL_OPTIONAL),
         ("need_full", 2, F.TYPE_STRING, F.LABEL_REPEATED),
+    ],
+    # Batched task leases (lease up to max_tasks per GetTask RPC).
+    "TaskBatch": [
+        ("tasks", 1, F.TYPE_MESSAGE, F.LABEL_REPEATED,
+         ".elasticdl_tpu.Task"),
+        ("finished", 2, F.TYPE_BOOL, F.LABEL_OPTIONAL),
+    ],
+    "ReportTaskResultsRequest": [
+        ("results", 1, F.TYPE_MESSAGE, F.LABEL_REPEATED,
+         ".elasticdl_tpu.ReportTaskResultRequest"),
+    ],
+    # Master-driven world hint (policy engine announces the next world
+    # so the AOT speculator compiles it instead of guessing N±delta).
+    "GetWorldHintRequest": [
+        ("worker_id", 1, F.TYPE_INT32, F.LABEL_OPTIONAL),
+    ],
+    "WorldHintResponse": [
+        ("hint_seq", 1, F.TYPE_INT64, F.LABEL_OPTIONAL),
+        ("target_world_size", 2, F.TYPE_INT32, F.LABEL_OPTIONAL),
+        ("reason", 3, F.TYPE_STRING, F.LABEL_OPTIONAL),
+        ("age_seconds", 4, F.TYPE_FLOAT, F.LABEL_OPTIONAL),
     ],
     "PushGradientsPackedRequest": [
         ("version", 1, F.TYPE_INT32, F.LABEL_OPTIONAL),
