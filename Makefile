@@ -29,9 +29,14 @@ reap:
 	-python tools/reap_orphans.py
 
 # Harness self-check: tiny shapes, CPU-safe, < 60 s, per-bench watchdog,
-# CI fields + the push serialize/wire/apply breakdown included.
+# CI fields + the push serialize/wire/apply breakdown included. The
+# result JSON and its per-workload step-time attribution table (the
+# input_wait sub-fraction split included) land under artifacts/ — the
+# CI-artifact form of the stderr table.
 bench-smoke: reap
-	JAX_PLATFORMS=cpu python -m elasticdl_tpu.bench --smoke
+	@mkdir -p artifacts
+	JAX_PLATFORMS=cpu python -m elasticdl_tpu.bench --smoke --out artifacts/bench_smoke.json
+	-python -m elasticdl_tpu.bench.attribution artifacts/bench_smoke.json > artifacts/attribution.txt
 
 # The regression gate: newest parseable BENCH_r*.json vs the previous
 # one; exits nonzero ONLY on a statistically significant practical
@@ -63,9 +68,11 @@ lint-changed:
 chaos: reap
 	set -o pipefail; timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly
 
-# The observability acceptance drill: a real 2w+2PS job with one worker
-# slowed by role-targeted chaos latency; the master's aggregator must
-# flag it (edl_job_straggler + alert event + /api/summary).
+# The observability acceptance drills: real 2w+2PS jobs — one worker
+# slowed by role-targeted chaos latency (edl_job_straggler + alert event
+# + /api/summary), and one worker's READER slowed at the datapath.read
+# local chaos point (input_starvation alert + datapath event trail +
+# dominant-stage attribution + `edl dash --once --json`).
 obs: reap
 	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/test_obs_aggregation.py -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly
 
@@ -89,15 +96,16 @@ native:
 # even when an earlier one fails (one run answers "what is broken"), and
 # the single trailing CI: line is the machine-readable verdict.
 ci:
-	@lint=FAIL; tier1=FAIL; gate=FAIL; fleet=FAIL; policy=FAIL; \
+	@lint=FAIL; tier1=FAIL; gate=FAIL; fleet=FAIL; obs=FAIL; policy=FAIL; \
 	set -o pipefail; lintlog=$$(mktemp); \
 	$(MAKE) --no-print-directory lint 2>&1 | tee $$lintlog && lint=ok; \
 	$(MAKE) --no-print-directory verify-tests && tier1=ok; \
 	$(MAKE) --no-print-directory fleet-smoke && fleet=ok; \
+	$(MAKE) --no-print-directory obs && obs=ok; \
 	$(MAKE) --no-print-directory policy-drill && policy=ok; \
 	$(MAKE) --no-print-directory bench-gate && gate=ok; \
 	rules=$$(grep -ao 'per-rule: .*' $$lintlog | tail -1); rm -f $$lintlog; \
-	echo "CI: lint=$$lint tier1=$$tier1 fleet=$$fleet policy=$$policy bench-gate=$$gate$${rules:+ [$$rules]}"; \
-	[ "$$lint" = ok ] && [ "$$tier1" = ok ] && [ "$$fleet" = ok ] && [ "$$policy" = ok ] && [ "$$gate" = ok ]
+	echo "CI: lint=$$lint tier1=$$tier1 fleet=$$fleet obs=$$obs policy=$$policy bench-gate=$$gate$${rules:+ [$$rules]}"; \
+	[ "$$lint" = ok ] && [ "$$tier1" = ok ] && [ "$$fleet" = ok ] && [ "$$obs" = ok ] && [ "$$policy" = ok ] && [ "$$gate" = ok ]
 
 .PHONY: proto test verify verify-tests reap bench-smoke bench-gate lint lint-changed chaos obs fleet-smoke policy-drill native ci

@@ -40,6 +40,15 @@ _PHASE_BUCKETS = {
     # critical path.
     "prefetch_embeddings": "input_wait",
     "prefetch_issue": "input_wait",
+    # Data-plane stages (observability/datapath.py): the same feed path
+    # decomposed — task-lease wait, record read, decode/parse, row
+    # collate, host-to-device copy, and empty-queue starvation.
+    "input_task": "input_wait",
+    "input_read": "input_wait",
+    "input_decode": "input_wait",
+    "input_collate": "input_wait",
+    "input_h2d": "input_wait",
+    "input_starve": "input_wait",
 }
 _BREAKDOWN_BUCKETS = {
     "serialize": "serialize",
@@ -47,8 +56,31 @@ _BREAKDOWN_BUCKETS = {
     "apply": "ps_wire",
 }
 
+# input_wait sub-attribution: phase -> sub-key. `input_collate` folds
+# into input_decode (both are host-side batch-build work); the legacy
+# embedding-prefetch phases keep contributing so PS-mode rows split even
+# where only the trainer-side phases exist — the issue path is host-side
+# id crunching (decode-shaped), the harvest is the device-copy wait
+# (h2d-shaped).
+_INPUT_SUB = {
+    "input_task": "input_task",
+    "input_read": "input_read",
+    "input_decode": "input_decode",
+    "input_collate": "input_decode",
+    "input_h2d": "input_h2d",
+    "input_starve": "input_starve",
+    "prefetch_issue": "input_decode",
+    "prefetch_embeddings": "input_h2d",
+}
+
 FRACTION_KEYS = (
     "compute", "ps_wire", "serialize", "input_wait", "recompile", "other"
+)
+
+# Rendered/tested order of the input_wait sub-fractions.
+INPUT_SUBKEYS = (
+    "input_task", "input_read", "input_decode", "input_h2d",
+    "input_starve",
 )
 
 
@@ -76,18 +108,38 @@ def _normalize(fractions):
     return out
 
 
+def _split_input(target, subs):
+    """Scale the raw per-sub fractions so they sum EXACTLY to the row's
+    normalized input_wait share (the sub-split must agree with the
+    undecomposed bucket it refines): proportional rescale, round to the
+    table's precision, shave the rounding residue off the largest sub."""
+    raw_total = sum(subs.values())
+    if raw_total <= 0:
+        return {}
+    scale = target / raw_total
+    out = {k: round(v * scale, 4) for k, v in subs.items()}
+    residue = round(target - sum(out.values()), 4)
+    if residue:
+        biggest = max(out, key=lambda k: out[k])
+        out[biggest] = max(0.0, round(out[biggest] + residue, 4))
+    return out
+
+
 def from_phases(step_time_ms, phase_mean_ms, push_breakdown_ms=None,
                 recompile_fraction=0.0):
     """Attribution for one PS-mode cell from its per-step phase means."""
     if not step_time_ms:
         return None
     fractions = {"recompile": recompile_fraction}
+    input_subs = {}
     for phase, bucket in _PHASE_BUCKETS.items():
         ms = (phase_mean_ms or {}).get(phase)
         if ms:
-            fractions[bucket] = fractions.get(bucket, 0.0) + (
-                ms / step_time_ms
-            )
+            frac = ms / step_time_ms
+            fractions[bucket] = fractions.get(bucket, 0.0) + frac
+            sub = _INPUT_SUB.get(phase)
+            if sub:
+                input_subs[sub] = input_subs.get(sub, 0.0) + frac
     breakdown = push_breakdown_ms or {}
     for part, bucket in _BREAKDOWN_BUCKETS.items():
         ms = breakdown.get(part)
@@ -105,7 +157,12 @@ def from_phases(step_time_ms, phase_mean_ms, push_breakdown_ms=None,
             fractions["serialize"] = fractions.get(
                 "serialize", 0.0
             ) + (push_ms - split) / step_time_ms
-    return _normalize(fractions)
+    out = _normalize(fractions)
+    if input_subs and out.get("input_wait"):
+        breakdown = _split_input(out["input_wait"], input_subs)
+        if breakdown:
+            out["input_breakdown"] = breakdown
+    return out
 
 
 def from_windows(result, wall_s, compile_s):
@@ -207,4 +264,46 @@ def render_table(table):
             "(* overlap-normalized: pipelined phases measured "
             "concurrently)"
         )
+    split_rows = {
+        name: row["input_breakdown"]
+        for name, row in table.items()
+        if row.get("input_breakdown")
+    }
+    if split_rows:
+        sub_head = "  ".join(f"{k:>12}" for k in INPUT_SUBKEYS)
+        lines.append("")
+        lines.append(
+            "input_wait breakdown (sub-fractions of step time; each "
+            "row sums to its input_wait above):"
+        )
+        lines.append(f"{'workload':<{width}}  {sub_head}")
+        for name in sorted(split_rows):
+            sub = split_rows[name]
+            cells = "  ".join(
+                f"{sub.get(k, 0.0):>12.3f}" for k in INPUT_SUBKEYS
+            )
+            lines.append(f"{name:<{width}}  {cells}")
     return "\n".join(lines)
+
+
+def main(argv=None):
+    """Render the attribution table archived inside a bench result file
+    (the `--out` JSON): `make bench-smoke` ships the text under
+    artifacts/ as the CI-artifact form of the stderr table."""
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser("bench.attribution")
+    parser.add_argument("result", help="bench result JSON (--out file)")
+    args = parser.parse_args(argv)
+    with open(args.result) as f:
+        data = json.load(f)
+    table = (data.get("details") or {}).get("attribution") or {}
+    print(render_table(table))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -161,6 +161,25 @@ def schedule_from_env():
         return _env_schedule
 
 
+def inject_local(point):
+    """Apply env-scheduled latency faults at a non-RPC injection point.
+
+    The interceptors above only reach calls that cross a channel, but
+    some drills need to perturb purely in-process code paths — e.g. the
+    input-starve scenario slows one worker's record reader by matching
+    rules against the synthetic method name "datapath.read". Same rule
+    grammar (method substring, start/count window, role targeting, seeded
+    jitter); only latency faults make sense here — the other kinds model
+    wire behavior — so anything else on a local point is ignored."""
+    schedule = schedule_from_env()
+    if schedule is None:
+        return
+    for rule in schedule.decide(point, "client"):
+        if rule.kind == "latency":
+            _INJECTED.labels(kind="latency", side="client").inc()
+            time.sleep(schedule.jitter(rule))
+
+
 class ChaosServerInterceptor(grpc.ServerInterceptor):
     """Injects scheduled faults into a server's handlers."""
 

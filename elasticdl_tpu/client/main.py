@@ -355,6 +355,14 @@ def _dash(args):
                 )
             except (OSError, ValueError):
                 summary = {}  # aggregator still warming up
+        if getattr(args, "json", False) and args.once:
+            # Machine-readable once-mode: the raw /api/summary snapshot
+            # (datapath block included) as one JSON object — the CI
+            # artifact form of the frame below.
+            import json as _json
+
+            print(_json.dumps(summary, sort_keys=True), flush=True)
+            return 1 if status.job_failed else 0
         frame = dashboard.render(
             summary, status, top=getattr(args, "top", 0)
         )
@@ -644,6 +652,12 @@ def main(argv=None):
             "--once",
             action="store_true",
             help="render one frame and exit (non-interactive/CI mode)",
+        )
+        dash.add_argument(
+            "--json",
+            action="store_true",
+            help="with --once: print the raw /api/summary JSON instead "
+            "of the rendered frame (CI artifact capture)",
         )
         dash.add_argument(
             "--iterations",

@@ -204,6 +204,20 @@ declare("ELASTICDL_PEAK_FLOPS", "float", 0.0,
         "Per-device peak FLOP/s override for MFU; 0 falls back to the "
         "device-kind table.")
 
+# -- data-plane instrumentation (observability/datapath.py) --
+declare("ELASTICDL_DATAPATH", "int", 1,
+        "Stage-level input-pipeline instrumentation (task/read/decode/"
+        "collate/h2d/starve stages as Timing phases, spans, and "
+        "edl_datapath_* series); 0 turns every stage into a no-op.")
+declare("ELASTICDL_DATAPATH_QUEUE_CAPACITY", "int", 1024,
+        "Default capacity QueueTelemetry assumes for a hand-off queue "
+        "whose constructor does not pass one (the prefetch queue passes "
+        "its real bound); sizes the backpressure watermark.")
+declare("ELASTICDL_DATAPATH_QUEUE_WATERMARK", "float", 0.8,
+        "Fraction of a hand-off queue's capacity at which occupancy "
+        "fires the edge-triggered datapath_backpressure event; <=0 "
+        "disables watermark events (the depth gauge stays live).")
+
 # -- push-based telemetry (observability/push.py, aggregator) --
 declare("ELASTICDL_TELEMETRY_PUSH_INTERVAL", "float", 0.0,
         "Seconds between push-telemetry reports from workers/PS to the "
@@ -251,6 +265,10 @@ declare("ELASTICDL_ALERT_STALL_SECONDS", "float", 60.0,
         "flight.")
 declare("ELASTICDL_ALERT_ABANDONED", "float", 1.0,
         "Abandoned-task count threshold for the abandonment alert.")
+declare("ELASTICDL_ALERT_STARVE_SHARE", "float", 0.25,
+        "Input-starvation alert threshold: fraction of a worker's wall "
+        "time spent with the step blocked on an empty feed queue "
+        "(datapath `starve` stage rate).")
 
 # -- rpc plane (common/rpc.py) --
 declare("ELASTICDL_RPC_DEADLINES", "str", "",

@@ -16,6 +16,10 @@ full queue.
 import queue
 import sys
 import threading
+import time
+
+from elasticdl_tpu.chaos import injection
+from elasticdl_tpu.observability import datapath
 
 _END = object()
 
@@ -31,6 +35,12 @@ class PrefetchReader:
     records are large (a 1024-record bound alone would hold ~150 MB of
     224x224 image Examples)."""
 
+    # Data-plane attribution marker: the producer thread below accounts
+    # record reads as the `read` stage, so downstream consumers
+    # (TaskDataService.read_batches) must book their queue waits as
+    # `starve`, not `read` — otherwise read time would count twice.
+    datapath_starve_waits = True
+
     def __init__(self, reader, buffer_records=1024,
                  buffer_bytes=DEFAULT_BUFFER_BYTES):
         if buffer_records < 1:
@@ -44,6 +54,13 @@ class PrefetchReader:
     def read_records(self, task):
         q = queue.Queue(maxsize=self._buffer_records)
         stop = threading.Event()
+        dp = datapath.get()
+        # Hand-off queue occupancy/backpressure telemetry; per-task is
+        # fine (one producer per task), and re-arming the watermark edge
+        # per task keeps excursions attributable to a task id.
+        q_telemetry = datapath.QueueTelemetry(
+            "prefetch", capacity=self._buffer_records, datapath=dp
+        )
         # Outstanding payload bytes, guarded by its own lock; the producer
         # parks while over budget (at least one record is always allowed
         # through so a single huge record can't deadlock).
@@ -81,10 +98,29 @@ class PrefetchReader:
             return False
 
         def produce():
+            # The producer owns the `read` stage: each pull from the
+            # wrapped reader is timed off the training thread (overlap
+            # means this cost only surfaces downstream as `starve` when
+            # the queue runs dry). Records are NOT counted here — the
+            # consumer's delivery boundary counts them exactly once.
+            it = iter(self._reader.read_records(task))
             try:
-                for record in self._reader.read_records(task):
+                while True:
+                    if dp.enabled:
+                        # The chaos hook sits INSIDE the timed window so
+                        # an injected slow reader shows up as `read`
+                        # seconds, exactly like a genuinely slow one.
+                        start = time.time()
+                        injection.inject_local("datapath.read")
+                        record = next(it, _END)
+                        dp.add("read", time.time() - start)
+                    else:
+                        record = next(it, _END)
+                    if record is _END:
+                        break
                     if not _put(record, _sizeof(record)):
                         return
+                    q_telemetry.depth(q.qsize())
             except BaseException as e:  # re-raised on the consumer side
                 _put((_END, e))
                 return

@@ -184,6 +184,25 @@ class SimPod:
             self._c_steps = self.registry.counter(
                 "edl_steps_total", "Steps simulated"
             )
+            # Data-plane families, same shapes as observability.datapath
+            # (the metric-names lint enforces one shape per name): the
+            # simulated feed path splits each step into read/decode with
+            # a small starve tail, so the aggregator's datapath rollup
+            # has fleet-scale input to derive from.
+            self._c_dp_seconds = self.registry.counter(
+                "edl_datapath_seconds_total",
+                "Input pipeline time by stage (simulated)",
+                labelnames=("stage",),
+            )
+            self._c_dp_records = self.registry.counter(
+                "edl_datapath_records_total",
+                "Records delivered to the training loop (simulated)",
+            )
+            self._g_dp_queue = self.registry.gauge(
+                "edl_datapath_queue_depth",
+                "Bounded feed queue occupancy (simulated)",
+                labelnames=("queue",),
+            )
         else:
             # Same labelnames as the real PS servicer: pods share no
             # registry with it, but the aggregator's per-shard derive
@@ -284,6 +303,18 @@ class SimPod:
             )
             self._h_phase.labels(phase="batch_process").observe(draw)
             self._c_steps.inc()
+            # Feed-path attribution moves with the step: a straggler's
+            # slowdown surfaces as starve seconds (its feed can't keep
+            # up), which is exactly what the starvation alert watches.
+            self._c_dp_seconds.labels(stage="read").inc(0.25 * draw)
+            self._c_dp_seconds.labels(stage="decode").inc(0.15 * draw)
+            starve = max(0.0, (self.straggler_factor - 1.0) * step)
+            if starve:
+                self._c_dp_seconds.labels(stage="starve").inc(starve)
+            self._c_dp_records.inc(64)
+            self._g_dp_queue.labels(queue="prefetch").set(
+                self._rng.randint(0, 64)
+            )
             self._task_rpc()
         else:
             shard = str(self.index)
@@ -657,6 +688,7 @@ class FleetHarness:
             "master_tick_p50_s": ticks[len(ticks) // 2] if ticks else None,
             "master_tick_max_s": ticks[-1] if ticks else None,
             "fleet": summary.get("fleet") or {},
+            "datapath": summary.get("datapath") or {},
             "roles_scraped": len(summary.get("roles_scraped") or ()),
             "summary_ts": summary.get("ts"),
         }

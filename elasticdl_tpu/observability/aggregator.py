@@ -350,6 +350,30 @@ class TelemetryAggregator:
             "Seconds spent compiling tracked step functions, summed "
             "across all scraped roles",
         )
+        # Data-plane rollups (observability/datapath.py): fleet-level
+        # views of the per-worker edl_datapath_* series.
+        self._g_dp_stage = reg.gauge(
+            "edl_job_datapath_stage_share",
+            "Fleet-summed input-pipeline stage rate (seconds of stage "
+            "time per wall second, over all workers)",
+            labelnames=("stage",),
+        )
+        self._g_dp_records = reg.gauge(
+            "edl_job_datapath_records_per_second",
+            "Fleet decode throughput: records/s delivered by the input "
+            "pipeline across all workers",
+        )
+        self._g_starve_share = reg.gauge(
+            "edl_job_input_starve_share",
+            "Fraction of the worker's wall time its step spent blocked "
+            "on an empty feed queue",
+            labelnames=("worker",),
+        )
+        self._g_input_starved = reg.gauge(
+            "edl_job_input_starved",
+            "1 while the input_starvation alert is active for the worker",
+            labelnames=("worker",),
+        )
         # Control-plane self-instrumentation (edl_master_*): the master
         # is itself a first-class telemetry subject at fleet scale.
         self._h_fanout = reg.histogram(
@@ -830,6 +854,63 @@ class TelemetryAggregator:
             self._g_compiles.labels(cause=cause).set(count)
         self._g_compile_seconds.set(compile_seconds)
 
+        # --- data-plane rollups (observability/datapath.py) ---
+        # Per-stage rates are seconds-of-stage-time per wall second, so
+        # the per-worker `starve` rate reads directly as "fraction of
+        # this worker's wall time the step sat on an empty feed".
+        dp_stage_rates = {}
+        starve_shares = {}
+        dp_records_rate = None
+        dp_queue_depth = {}
+        dp_backpressure = None
+        for role in self.store.roles():
+            if not role.startswith("worker"):
+                continue
+            for labels in self.store.labelsets(
+                role, "edl_datapath_seconds_total"
+            ):
+                rate = self.store.rate(
+                    role, "edl_datapath_seconds_total", labels, now=now
+                )
+                if rate is None:
+                    continue
+                stage = dict(labels).get("stage", "?")
+                dp_stage_rates[stage] = (
+                    dp_stage_rates.get(stage, 0.0) + rate
+                )
+                if stage == "starve":
+                    starve_shares[role] = (
+                        starve_shares.get(role, 0.0) + rate
+                    )
+            rec_rate = self._family_rate(
+                role, "edl_datapath_records_total", now=now
+            )
+            if rec_rate is not None:
+                dp_records_rate = (dp_records_rate or 0.0) + rec_rate
+            for labels in self.store.labelsets(
+                role, "edl_datapath_queue_depth"
+            ):
+                depth = self.store.latest(
+                    role, "edl_datapath_queue_depth", labels
+                )
+                if depth is not None:
+                    qname = dict(labels).get("queue", "?")
+                    dp_queue_depth[f"{role}/{qname}"] = depth
+            bp = self._family_total(
+                role, "edl_datapath_backpressure_total"
+            )
+            if bp is not None:
+                dp_backpressure = (dp_backpressure or 0.0) + bp
+        for stage, rate in dp_stage_rates.items():
+            self._g_dp_stage.labels(stage=stage).set(rate)
+        if dp_records_rate is not None:
+            self._g_dp_records.set(dp_records_rate)
+        dominant_stage = (
+            max(dp_stage_rates, key=dp_stage_rates.get)
+            if dp_stage_rates
+            else None
+        )
+
         # --- alerts ---
         signals = {
             "records_per_second": rps,
@@ -839,6 +920,7 @@ class TelemetryAggregator:
             "tasks_abandoned": abandoned,
             "tasks_todo": todo,
             "tasks_doing": doing,
+            "input_starve_shares": starve_shares,
         }
         self.engine.evaluate(signals, now)
         flagged = set(self.engine.active_subjects("straggler"))
@@ -848,6 +930,12 @@ class TelemetryAggregator:
                 1 if is_straggler else 0
             )
             workers[role]["straggler"] = is_straggler
+        starved = set(self.engine.active_subjects("input_starvation"))
+        for role, share in starve_shares.items():
+            self._g_starve_share.labels(worker=role).set(share)
+            self._g_input_starved.labels(worker=role).set(
+                1 if role in starved else 0
+            )
         # A worker that stopped reporting (scaled away, dead) must not
         # pin ANY of its per-worker gauges on /metrics forever — and its
         # EWMA must not seed a relaunched instance's scoring.
@@ -857,6 +945,9 @@ class TelemetryAggregator:
             for stat in ("mean", "p50", "p99", "ewma"):
                 self._g_step.labels(worker=role, stat=stat).set(0)
             self._g_mfu.labels(worker=role).set(0)
+            if role not in starve_shares:
+                self._g_starve_share.labels(worker=role).set(0)
+                self._g_input_starved.labels(worker=role).set(0)
             self._ewma.pop(role, None)
         self._gauged_workers |= set(step_means)
         self._g_workers.set(len(workers))
@@ -946,6 +1037,27 @@ class TelemetryAggregator:
                 "by_cause": compile_counts,
                 "edl_compile_seconds_total": round(compile_seconds, 4),
             },
+            # Empty until workers report edl_datapath_* series (older
+            # workers, ELASTICDL_DATAPATH=0): consumers skip the panel.
+            "datapath": (
+                {
+                    "stages": {
+                        s: round(v, 4)
+                        for s, v in sorted(dp_stage_rates.items())
+                    },
+                    "dominant_stage": dominant_stage,
+                    "records_per_second": dp_records_rate,
+                    "starve_shares": {
+                        r: round(v, 4)
+                        for r, v in sorted(starve_shares.items())
+                    },
+                    "starved": sorted(starved),
+                    "queue_depth": dp_queue_depth,
+                    "backpressure_total": dp_backpressure,
+                }
+                if dp_stage_rates or dp_records_rate is not None
+                else {}
+            ),
         }
         with self._lock:
             self._summary = summary

@@ -31,6 +31,9 @@ Tuning (all optional):
   ELASTICDL_ALERT_STALL_SECONDS   records_done frozen this long with
                                   tasks in flight -> stall (def 60)
   ELASTICDL_ALERT_ABANDONED       abandoned-task count threshold (def 1)
+  ELASTICDL_ALERT_STARVE_SHARE    flag workers whose step sat on an
+                                  empty feed queue more than this
+                                  fraction of wall time (def 0.25)
 """
 
 import threading
@@ -47,6 +50,7 @@ STRAGGLER_SKEW_ENV = "ELASTICDL_ALERT_STRAGGLER_SKEW"
 PS_SKEW_ENV = "ELASTICDL_ALERT_PS_SKEW"
 STALL_SECONDS_ENV = "ELASTICDL_ALERT_STALL_SECONDS"
 ABANDONED_ENV = "ELASTICDL_ALERT_ABANDONED"
+STARVE_SHARE_ENV = "ELASTICDL_ALERT_STARVE_SHARE"
 
 class Rule:
     """One named condition; evaluate() returns {subject: detail_dict} for
@@ -156,6 +160,16 @@ def default_rules():
             progress="records_done",
             gate="tasks_doing",
             seconds=knobs.get_float(STALL_SECONDS_ENV),
+        ),
+        # input_starve_shares are ABSOLUTE fractions of wall time (the
+        # aggregator owns the normalization, per the SkewRule contract),
+        # so the threshold compares against the share itself rather
+        # than a fleet median — starvation on every worker at once is
+        # still an incident.
+        SkewRule(
+            "input_starvation",
+            "input_starve_shares",
+            knobs.get_float(STARVE_SHARE_ENV),
         ),
     ]
 

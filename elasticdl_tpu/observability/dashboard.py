@@ -197,6 +197,51 @@ def render(summary, status=None, width=None, top=0):
                 f"{bar(totals[role], scale)}{ratio_txt}"
             )
 
+    dp = summary.get("datapath") or {}
+    if dp:
+        lines.append("")
+        stages = dp.get("stages") or {}
+        head = "data plane"
+        if dp.get("records_per_second") is not None:
+            head += (
+                f" {_fmt_rate(dp.get('records_per_second'), ' rec/s')}"
+            )
+        if dp.get("dominant_stage"):
+            head += f"  slowest stage: {dp['dominant_stage']}"
+        if dp.get("backpressure_total"):
+            head += (
+                f"  backpressure={_int(dp.get('backpressure_total'))}"
+            )
+        lines.append(head)
+        if stages:
+            lines.append(
+                "  "
+                + "  ".join(
+                    f"{s}={v:.3f}" for s, v in sorted(stages.items())
+                )
+                + "  (stage-seconds per wall second, fleet)"
+            )
+        starve = dp.get("starve_shares") or {}
+        starved = set(dp.get("starved") or [])
+        worst = sorted(
+            starve, key=lambda r: starve[r], reverse=True
+        )[: top or len(starve)]
+        for role in worst:
+            share = starve[role]
+            if not share and role not in starved:
+                continue
+            flag = "  ⚠ STARVED" if role in starved else ""
+            lines.append(
+                f"  {role:<12} starve={share * 100:5.1f}%  "
+                f"{bar(share, 1.0)}{flag}"
+            )
+        queues = dp.get("queue_depth") or {}
+        if queues:
+            depth_txt = " ".join(
+                f"{q}={_int(d)}" for q, d in sorted(queues.items())
+            )
+            lines.append(f"  queue depth: {depth_txt}"[:width])
+
     policy = summary.get("policy") or {}
     if policy.get("enabled"):
         lines.append("")

@@ -35,6 +35,7 @@ from elasticdl_tpu.common.pytree_utils import (
     walk_dict as _walk_dict,
 )
 from elasticdl_tpu.layers.embedding import EMBEDDING_COLLECTION
+from elasticdl_tpu.observability import datapath
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 from elasticdl_tpu.worker.trainer import JaxTrainer, _to_device_batch
 
@@ -620,8 +621,9 @@ class ParameterServerTrainer(JaxTrainer):
             return self._train_minibatch_pipelined(
                 features, labels, next_features
             )
-        device_features = _to_device_batch(features)
-        device_labels = _to_device_batch(labels)
+        with datapath.get().stage("h2d", timing=self.timing):
+            device_features = _to_device_batch(features)
+            device_labels = _to_device_batch(labels)
         for attempt in range(self._max_push_retries):
             # Issue the embedding pulls BEFORE the dense pull waits:
             # both fan-outs ride the wire together instead of in series.
@@ -694,8 +696,9 @@ class ParameterServerTrainer(JaxTrainer):
             self._push_executor = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="edl-ps-push"
             )
-        device_features = _to_device_batch(features)
-        device_labels = _to_device_batch(labels)
+        with datapath.get().stage("h2d", timing=self.timing):
+            device_features = _to_device_batch(features)
+            device_labels = _to_device_batch(labels)
         # These RPCs overlap the PREVIOUS step's device compute.
         handle = self._take_pending_prefetch(features)
         if handle is None:

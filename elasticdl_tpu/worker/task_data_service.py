@@ -10,12 +10,15 @@ task re-runs whole.
 """
 
 import collections
+import itertools
 import time
 
 import grpc
 
+from elasticdl_tpu.chaos import injection
 from elasticdl_tpu.common import knobs
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.observability import datapath
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
 logger = get_logger("worker.task_data_service")
@@ -88,7 +91,13 @@ class TaskDataService:
     def get_task(self, task_type=pb.TRAINING, wait=True):
         """Next task from the master; blocks through WAIT states (queue
         momentarily empty) and rides out transient master outages. Returns
-        None when the job is finished."""
+        None when the job is finished. The whole wait — RPC round-trips
+        plus WAIT-state sleeps — lands as the data plane's `task` stage
+        (the worker is input-starved on control-plane latency here)."""
+        with datapath.get().stage("task"):
+            return self._get_task(task_type, wait)
+
+    def _get_task(self, task_type, wait):
         if self._lease_batch > 1 and task_type == pb.TRAINING:
             return self._get_task_batched(wait)
         while True:
@@ -125,7 +134,7 @@ class TaskDataService:
                         "single-task leases"
                     )
                     self._lease_batch = 1
-                    return self.get_task(pb.TRAINING, wait)
+                    return self._get_task(pb.TRAINING, wait)
                 raise
             if res.tasks:
                 self._leased.extend(res.tasks)
@@ -145,21 +154,44 @@ class TaskDataService:
 
     def read_batches(self, task, batch_size):
         """Yield lists of raw records for the task, batch_size at a time
-        (last batch may be smaller)."""
-        batch = []
-        for record in self._reader.read_records(task):
-            batch.append(record)
-            if len(batch) >= batch_size:
-                yield batch
-                batch = []
-        if batch:
+        (last batch may be smaller).
+
+        Data-plane attribution: with a prefetching reader (it marks
+        itself with `datapath_starve_waits`) the producer thread already
+        accounts record reads as the `read` stage, so the consumer's
+        wait here is `starve` — the step could not start because no
+        batch was ready. With a synchronous reader the pull IS the read.
+        Records are counted here, at the delivery boundary, exactly
+        once."""
+        dp = datapath.get()
+        wait_stage = (
+            "starve"
+            if getattr(self._reader, "datapath_starve_waits", False)
+            else "read"
+        )
+        it = iter(self._reader.read_records(task))
+        while True:
+            with dp.stage(wait_stage) as s:
+                if wait_stage == "read":
+                    injection.inject_local("datapath.read")
+                batch = list(itertools.islice(it, batch_size))
+                s.records = len(batch)
+            if not batch:
+                return
             yield batch
+            if len(batch) < batch_size:
+                return
 
     def read_range(self, lease_range):
         """All records of one lease sub-range (LeaseRange carries the same
         shard_name/start/end attributes a Task does, so readers take it
         as-is)."""
-        return list(self._reader.read_records(lease_range))
+        dp = datapath.get()
+        with dp.stage("read") as s:
+            injection.inject_local("datapath.read")
+            records = list(self._reader.read_records(lease_range))
+            s.records = len(records)
+        return records
 
     def report_task(self, task_id, err_message="", exec_counters=None):
         """Report a task result, riding out a master outage the same way

@@ -16,6 +16,7 @@ import numpy as np
 import optax
 
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.observability import datapath
 
 logger = get_logger("worker.trainer")
 
@@ -216,12 +217,15 @@ class JaxTrainer(Trainer):
     def train_minibatch(self, features, labels):
         self.init_variables_if_needed(features)
         self._rng, step_rng = jax.random.split(self._rng)
+        with datapath.get().stage("h2d", timing=self.timing):
+            device_features = _to_device_batch(features)
+            device_labels = _to_device_batch(labels)
         step_args = (
             self._variables,
             self._opt_state,
             step_rng,
-            _to_device_batch(features),
-            _to_device_batch(labels),
+            device_features,
+            device_labels,
         )
         # Keyed on the batch only: param shapes are static after init.
         self.step_cost.observe(

@@ -17,7 +17,7 @@ from elasticdl_tpu.common.constants import (
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.model_utils import Modes
 from elasticdl_tpu.common.timing import Timing
-from elasticdl_tpu.observability import tracing
+from elasticdl_tpu.observability import datapath, tracing
 from elasticdl_tpu.observability.metrics import default_registry
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 from elasticdl_tpu.worker.task_data_service import TaskDataService
@@ -73,6 +73,11 @@ class Worker:
         self._lease_mode = lease_mode
         self._steps = 0
         self._timing = Timing().bind_histogram(_PHASE_SECONDS)
+        # Data-plane stages recorded off the worker loop (task acquire,
+        # read/starve, decode) mirror into this Timing as input_<stage>
+        # phases; the trainer's h2d stage binds its own Timing at the
+        # call site so bench attribution sees it in the trainer summary.
+        datapath.get().bind_timing(self._timing)
         trainer_timing = getattr(trainer, "timing", None)
         if trainer_timing is not None:
             # Trainer phases (pull/step/push) reach /metrics through the
@@ -232,16 +237,19 @@ class Worker:
             tracing.set_context(lease_epoch=lease.epoch)
             try:
                 loss = None
+                dp = datapath.get()
                 for i in range(lease.n_steps):
                     # Cycle this rank's records to fill every batch: all
                     # ranks must dispatch identically-shaped steps.
-                    rows = [
-                        records[(i * B + j) % len(records)]
-                        for j in range(B)
-                    ]
-                    features, labels = self._spec.feed(
-                        rows, Modes.TRAINING, self._metadata
-                    )
+                    with dp.stage("collate"):
+                        rows = [
+                            records[(i * B + j) % len(records)]
+                            for j in range(B)
+                        ]
+                    with dp.stage("decode"):
+                        features, labels = self._spec.feed(
+                            rows, Modes.TRAINING, self._metadata
+                        )
                     loss = self._trainer.train_lease_minibatch(
                         features, labels
                     )
@@ -365,6 +373,9 @@ class Worker:
             trainer_timing = getattr(self._trainer, "timing", None)
             if trainer_timing is not None:
                 trainer_timing.report(logger, reset=True)
+            # One `datapath` event per task: the per-stage seconds this
+            # task spent in the feed path, keyed by task id.
+            datapath.get().flush_event(task_id=task.task_id)
 
     def _process_with_retries(self, process_batch, records):
         """Per-minibatch retry (reference worker.py:165-218): transient
@@ -384,9 +395,10 @@ class Worker:
                 )
 
     def _process_train_batch(self, records):
-        features, labels = self._spec.feed(
-            records, Modes.TRAINING, self._metadata
-        )
+        with datapath.get().stage("decode"):
+            features, labels = self._spec.feed(
+                records, Modes.TRAINING, self._metadata
+            )
         if self._profile_dir:
             # Before the dispatch, so the trace window covers exactly the
             # steps the log names.
@@ -447,16 +459,18 @@ class Worker:
             logger.warning("Failed to finalize profile", exc_info=True)
 
     def _process_eval_batch(self, records):
-        features, labels = self._spec.feed(
-            records, Modes.EVALUATION, self._metadata
-        )
+        with datapath.get().stage("decode"):
+            features, labels = self._spec.feed(
+                records, Modes.EVALUATION, self._metadata
+            )
         outputs = self._trainer.evaluate_minibatch(features)
         self._mc.report_evaluation_metrics(outputs, labels)
 
     def _process_predict_batch(self, records, processor):
-        features, _ = self._spec.feed(
-            records, Modes.PREDICTION, self._metadata
-        )
+        with datapath.get().stage("decode"):
+            features, _ = self._spec.feed(
+                records, Modes.PREDICTION, self._metadata
+            )
         outputs = self._trainer.predict_minibatch(features)
         if processor is not None:
             processor.process(outputs, self._worker_id)

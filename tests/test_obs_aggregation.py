@@ -91,6 +91,50 @@ def test_promtext_parse_structure_and_escapes():
     assert ("edl_rt_total", {}, 3.0) in flat
 
 
+def test_promtext_roundtrip_datapath_families():
+    """The data-plane families (stage-labeled counter + histogram,
+    queue gauges) survive expose->parse->to_text byte-identically —
+    the property the aggregator's scrape ingestion rests on."""
+    reg = MetricsRegistry()
+    sec = reg.counter(
+        "edl_datapath_seconds_total", "stage seconds",
+        labelnames=("stage",),
+    )
+    for stage, v in (
+        ("task", 0.01), ("read", 0.2), ("decode", 0.05),
+        ("h2d", 0.02), ("starve", 0.5),
+    ):
+        sec.labels(stage=stage).inc(v)
+    hist = reg.histogram(
+        "edl_datapath_stage_seconds", "per-op stage latency",
+        labelnames=("stage",), buckets=(0.001, 0.01, 0.1, 1.0),
+    )
+    for v in (0.0005, 0.05, 0.5):
+        hist.labels(stage="read").observe(v)
+    reg.counter("edl_datapath_records_total", "records").inc(640)
+    reg.gauge(
+        "edl_datapath_queue_depth", "depth", labelnames=("queue",)
+    ).labels(queue="prefetch").set(17)
+    text = reg.expose()
+    families = promtext.parse(text)
+    assert promtext.to_text(families) == text
+    assert families["edl_datapath_stage_seconds"].type == "histogram"
+    assert promtext.sample_value(
+        families, "edl_datapath_stage_seconds_bucket",
+        {"le": "+Inf", "stage": "read"},
+    ) == 3
+    assert promtext.sample_value(
+        families, "edl_datapath_stage_seconds_bucket",
+        {"le": "0.001", "stage": "read"},
+    ) == 1
+    assert promtext.sample_value(
+        families, "edl_datapath_seconds_total", {"stage": "starve"}
+    ) == 0.5
+    assert promtext.sample_value(
+        families, "edl_datapath_records_total"
+    ) == 640
+
+
 def test_promtext_rejects_garbage():
     with pytest.raises(promtext.ParseError):
         promtext.parse("edl_x{unterminated 1\n")
@@ -342,6 +386,95 @@ def test_aggregator_scrapes_derives_and_exports(tmp_path):
     ), events
 
 
+def test_aggregator_datapath_rollup_and_starvation_alert(tmp_path):
+    """Two workers reporting edl_datapath_* series, one spending half
+    its wall time on an empty feed: the aggregator must roll up fleet
+    stage rates, name the dominant stage, fire the input_starvation
+    alert for exactly the starved worker (both /metrics surfaces), and
+    publish the datapath block /api/summary and `edl dash` consume."""
+    obs_dir = str(tmp_path)
+    regs = {}
+    exporters = []
+    starve_s = {"worker-0": 5.0, "worker-1": 0.1}
+    for role in ("worker-0", "worker-1"):
+        reg = MetricsRegistry()
+        reg.counter(
+            "edl_datapath_seconds_total", "stage seconds",
+            labelnames=("stage",),
+        )
+        reg.counter("edl_datapath_records_total", "records")
+        reg.gauge(
+            "edl_datapath_queue_depth", "depth", labelnames=("queue",)
+        )
+        reg.counter(
+            "edl_datapath_backpressure_total", "bp",
+            labelnames=("queue",),
+        )
+        regs[role] = reg
+        exporter = MetricsExporter(reg, port=0, host="127.0.0.1")
+        exporters.append(exporter)
+        _write_endpoint(obs_dir, role, exporter.port)
+    master_reg = MetricsRegistry()
+    log = obs_events.EventLog(str(tmp_path / "events.jsonl"), job="dp")
+    obs_events.set_event_log(log)
+    agg = TelemetryAggregator(
+        obs_dir, registry=master_reg, job="dp", interval=1.0
+    )
+    try:
+        def tick(t):
+            for role, reg in regs.items():
+                sec = reg.get("edl_datapath_seconds_total")
+                sec.labels(stage="read").inc(0.2)
+                sec.labels(stage="decode").inc(0.1)
+                sec.labels(stage="starve").inc(starve_s[role])
+                reg.get("edl_datapath_records_total").inc(250)
+                reg.get("edl_datapath_queue_depth").labels(
+                    queue="prefetch"
+                ).set(3)
+            regs["worker-0"].get(
+                "edl_datapath_backpressure_total"
+            ).labels(queue="prefetch").inc()
+            agg.poll_once(now=t)
+
+        tick(1000.0)
+        tick(1010.0)
+        summary = agg.summary()
+        dp = summary["datapath"]
+        # 5s of starve per 10s wall on worker-0 -> 0.5 share, dominant.
+        assert dp["dominant_stage"] == "starve"
+        assert dp["starve_shares"]["worker-0"] == pytest.approx(
+            0.5, rel=0.05
+        )
+        assert dp["starve_shares"]["worker-1"] == pytest.approx(
+            0.01, rel=0.05
+        )
+        assert dp["starved"] == ["worker-0"]
+        assert set(dp["stages"]) == {"read", "decode", "starve"}
+        # 250 records per worker per 10s tick, two workers -> 50/s.
+        assert dp["records_per_second"] == pytest.approx(50.0)
+        assert dp["queue_depth"]["worker-0/prefetch"] == 3
+        assert dp["backpressure_total"] == 2
+        json.dumps(summary)  # backs /api/summary
+        text = master_reg.expose()
+        assert 'edl_job_input_starved{worker="worker-0"} 1' in text
+        assert 'edl_job_input_starved{worker="worker-1"} 0' in text
+        assert 'edl_job_datapath_stage_share{stage="starve"}' in text
+        assert "edl_job_datapath_records_per_second 50" in text
+    finally:
+        obs_events.set_event_log(None)
+        log.close()
+        agg.close()
+        for exporter in exporters:
+            exporter.close()
+    events = obs_events.read_events(str(tmp_path / "events.jsonl"))
+    assert any(
+        e["kind"] == "alert"
+        and e.get("rule") == "input_starvation"
+        and e.get("subject") == "worker-0"
+        for e in events
+    ), [e["kind"] for e in events]
+
+
 # ---------- exporter surface ----------
 
 
@@ -438,6 +571,33 @@ def test_dashboard_render_synthetic_summary():
     assert dashboard.sparkline([1, 2, 3]) != ""
     # Empty summary (aggregator warming up) must still render.
     assert "job ?" in dashboard.render({}, width=80)
+
+
+def test_dashboard_render_datapath_panel():
+    from elasticdl_tpu.observability import dashboard
+
+    summary = {
+        "job": "demo",
+        "datapath": {
+            "stages": {"read": 0.04, "decode": 0.02, "starve": 0.51},
+            "dominant_stage": "starve",
+            "records_per_second": 5000.0,
+            "starve_shares": {"worker-0": 0.5, "worker-1": 0.0},
+            "starved": ["worker-0"],
+            "queue_depth": {"worker-0/prefetch": 3},
+            "backpressure_total": 2,
+        },
+    }
+    frame = dashboard.render(summary, width=100)
+    assert "data plane" in frame
+    assert "slowest stage: starve" in frame
+    assert "backpressure=2" in frame
+    assert "STARVED" in frame and "worker-0" in frame
+    # The healthy worker's zero-share row is suppressed, not rendered.
+    assert "worker-1" not in frame
+    assert "queue depth: worker-0/prefetch=3" in frame
+    # No datapath block (old workers, ELASTICDL_DATAPATH=0): no panel.
+    assert "data plane" not in dashboard.render({"job": "x"}, width=100)
 
 
 # ---------- worker MFU estimator ----------
@@ -605,3 +765,75 @@ def test_scenario_straggler(tmp_path):
     assert result.get("dash_rc") == 0, result.get("dash_snapshot")
     snapshot = result.get("dash_snapshot", "")
     assert "worker-0" in snapshot and "STRAGGLER" in snapshot, snapshot
+
+
+# ---------- end-to-end input-starvation drill (chaos lane) ----------
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_scenario_input_starve(tmp_path):
+    """A real 2w+2PS job with per-record latency injected into
+    worker-0's reader (the datapath.read local chaos point): the
+    data-plane telemetry must attribute the slowdown — the
+    input_starvation alert fires for exactly worker-0 on the master's
+    /metrics and /api/summary, the datapath event trail lands in
+    events.jsonl, the summary's data-plane block blames the injected
+    stage, `edl dash --once --json` returns a machine-readable snapshot
+    carrying the block — and the job must still complete with full
+    records_done."""
+    import test_module
+    from elasticdl_tpu.data.recordfile import RecordFileWriter
+
+    from elastic_drill import run_drill
+
+    records = 256
+    num_epochs = 40
+    data = str(tmp_path / "linear.edlr")
+    with RecordFileWriter(data) as w:
+        for r in test_module.make_linear_records(records):
+            w.write(r)
+    obs_dir = str(tmp_path / "obs")
+    result = run_drill(
+        data,
+        model_zoo=os.path.join(REPO, "tests"),
+        model_def="test_module",
+        num_workers=2,
+        num_ps=2,
+        num_epochs=num_epochs,
+        scenario="input-starve",
+        obs_dir=obs_dir,
+        env_overrides={
+            "JAX_PLATFORMS": "cpu",
+            "ELASTICDL_OBS_DIR": obs_dir,
+        },
+        timeout=420,
+    )
+    tail = result.get("log_tail", "")[-1500:]
+    assert result["completed"], tail
+    assert result["leftover_procs"] == [], result["leftover_procs"]
+    assert result["records_done"] == records * num_epochs, (
+        result["records_done"], tail,
+    )
+    # The alert named EXACTLY the faulted worker on both surfaces.
+    assert result["starved_flagged"] == "worker-0", result
+    assert result["starved_workers"] == ["worker-0"], result
+    # The attribution blames the injected stage: a slow reader surfaces
+    # as producer `read` seconds and consumer `starve` seconds.
+    assert result["dominant_stage"] in ("read", "starve"), result
+    dp = result["datapath_summary"]
+    assert dp["starve_shares"].get("worker-0", 0) > 0, dp
+    # The per-task datapath event trail landed in events.jsonl.
+    assert result["datapath_event"] is not None, result
+    assert result["datapath_event"].get("records"), result
+    # The alert event too (rising edge, rule + subject).
+    events = obs_events.read_events(os.path.join(obs_dir, "events.jsonl"))
+    assert any(
+        e["kind"] == "alert"
+        and e.get("rule") == "input_starvation"
+        and e.get("subject") == "worker-0"
+        for e in events
+    ), [e["kind"] for e in events]
+    # Machine-readable dashboard snapshot against the live job.
+    assert result.get("dash_json_rc") == 0, result
+    assert result.get("dash_json_has_datapath") is True, result

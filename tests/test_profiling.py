@@ -326,6 +326,72 @@ def test_attribution_fractions_sum_to_at_most_one():
     assert serial["serialize"] == 0.1
 
 
+def test_attribution_input_breakdown_sums_to_input_wait():
+    """The data-plane sub-split must agree with the undecomposed bucket
+    it refines: sum(input_breakdown) == input_wait (within the table's
+    rounding), with at least 4 sub-stages when the datapath phases are
+    present."""
+    row = attribution.from_phases(
+        step_time_ms=10.0,
+        phase_mean_ms={
+            "input_task": 0.5,
+            "input_read": 2.0,
+            "input_decode": 0.7,
+            "input_collate": 0.3,
+            "input_h2d": 0.5,
+            "input_starve": 1.0,
+            "train_step": 4.0,
+        },
+    )
+    sub = row["input_breakdown"]
+    assert set(sub) <= set(attribution.INPUT_SUBKEYS)
+    assert len(sub) >= 4
+    assert abs(sum(sub.values()) - row["input_wait"]) <= 0.02
+    # collate folds into decode: 0.7 + 0.3 of the 5ms input total.
+    expected_decode = row["input_wait"] * (1.0 / 5.0)
+    assert abs(sub["input_decode"] - expected_decode) <= 0.02
+
+    # Overlap-normalized rows keep the invariant too: raw phases sum
+    # past the step, so every fraction (and each sub) is rescaled.
+    over = attribution.from_phases(
+        step_time_ms=10.0,
+        phase_mean_ms={
+            "input_read": 6.0,
+            "input_starve": 3.0,
+            "train_step": 8.0,
+        },
+    )
+    assert over["overlapped"] is True
+    assert abs(
+        sum(over["input_breakdown"].values()) - over["input_wait"]
+    ) <= 0.02
+
+    # Legacy embedding-prefetch phases map onto the sub-keys so PS rows
+    # split even without the new datapath phases.
+    legacy = attribution.from_phases(
+        step_time_ms=10.0,
+        phase_mean_ms={
+            "prefetch_issue": 1.0,
+            "prefetch_embeddings": 2.0,
+            "train_step": 5.0,
+        },
+    )
+    sub = legacy["input_breakdown"]
+    assert set(sub) == {"input_decode", "input_h2d"}
+    assert abs(sum(sub.values()) - legacy["input_wait"]) <= 0.02
+
+    # No input phases at all: no breakdown key.
+    bare = attribution.from_phases(
+        step_time_ms=10.0, phase_mean_ms={"train_step": 5.0}
+    )
+    assert "input_breakdown" not in bare
+
+    # The rendered table carries the second section for split rows.
+    rendered = attribution.render_table({"w": row, "bare": bare})
+    assert "input_wait breakdown" in rendered
+    assert "input_starve" in rendered
+
+
 def test_attribution_windowed_and_build_all():
     result = {
         "examples_per_sec": 100.0,

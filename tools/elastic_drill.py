@@ -54,6 +54,7 @@ SCENARIOS = (
     "backup-task",
     "deadline-scale",
     "preemption-wave",
+    "input-starve",
 )
 
 # Scenarios that close the loop through the policy engine: they need the
@@ -242,6 +243,31 @@ def scenario_env(scenario):
             ELASTICDL_POLICY_STRAGGLER_SCORE="1e9",
             ELASTICDL_POLICY_MAX_BACKUPS="0",
         )
+    if scenario == "input-starve":
+        # A slow READER, not a slow network: per-record latency injected
+        # at the data plane's local chaos point (datapath.read) on
+        # worker-0 only. The trainer side starves on an empty prefetch
+        # queue, the datapath telemetry must attribute it (read/starve
+        # dominant, starvation alert on exactly worker-0) while the job
+        # still completes with full records_done.
+        schedule = {
+            "seed": 20260807,
+            "rules": [
+                {
+                    "method": "datapath.read",
+                    "kind": "latency",
+                    "latency_s": 0.008,
+                    "start": 0,
+                    "count": -1,
+                    "side": "client",
+                    "role": "worker-0",
+                },
+            ],
+        }
+        return {
+            "ELASTICDL_CHAOS": json.dumps(schedule),
+            "ELASTICDL_AGGREGATOR_INTERVAL": "1.0",
+        }
     if scenario == "master-stall":
         # Shrink the control-plane deadlines below the stall length so the
         # workers' calls fail fast and RETRY through the stall (instead of
@@ -350,10 +376,10 @@ def run_drill(
 
     if scenario not in SCENARIOS:
         raise ValueError(f"unknown scenario {scenario!r}; one of {SCENARIOS}")
-    if scenario == "straggler" and not obs_dir:
+    if scenario in ("straggler", "input-starve") and not obs_dir:
         raise ValueError(
-            "the straggler scenario needs --obs_dir: detection is read "
-            "from the master's aggregated /metrics and /api/summary"
+            f"the {scenario} scenario needs --obs_dir: detection is "
+            "read from the master's aggregated /metrics and /api/summary"
         )
     if scenario in POLICY_SCENARIOS and not obs_dir:
         raise ValueError(
@@ -478,6 +504,10 @@ def run_drill(
             chaos_process.stall(train.pid, stall_seconds)
         elif scenario == "straggler":
             s = _do_straggler_watch(
+                status, s, port, obs_dir, result, timeout, env
+            )
+        elif scenario == "input-starve":
+            s = _do_input_starve_watch(
                 status, s, port, obs_dir, result, timeout, env
             )
         elif scenario == "straggler-recovery":
@@ -642,6 +672,86 @@ def _do_straggler_watch(status, s, port, obs_dir, result, timeout, env):
         except subprocess.TimeoutExpired:
             result["dash_snapshot"] = ""
             result["dash_rc"] = -1
+    return s
+
+
+def _do_input_starve_watch(status, s, port, obs_dir, result, timeout,
+                           env):
+    """Watch the master's data-plane rollups until they attribute the
+    injected slow reader: `edl_job_input_starved{worker="worker-0"} 1`
+    on the master's /metrics (the input_starvation alert, re-exported),
+    the /api/summary datapath block naming a dominant stage, the
+    `datapath` event trail in events.jsonl, and — while the job is still
+    live — one `edl dash --once --json` machine-readable snapshot."""
+    deadline = time.time() + timeout
+    result["starved_flagged"] = None
+    result["datapath_summary"] = None
+    result["dominant_stage"] = None
+    while time.time() < deadline:
+        info = _master_endpoint(obs_dir)
+        if info is not None:
+            try:
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{info['port']}/metrics", timeout=2
+                ).read().decode()
+                m = re.search(
+                    r'^edl_job_input_starved\{worker="([^"]+)"\} 1$',
+                    body,
+                    re.M,
+                )
+                if m:
+                    result["starved_flagged"] = m.group(1)
+                    summary = json.loads(
+                        urllib.request.urlopen(
+                            f"http://127.0.0.1:{info['port']}/api/summary",
+                            timeout=2,
+                        ).read().decode()
+                    )
+                    dp = summary.get("datapath") or {}
+                    result["datapath_summary"] = dp
+                    result["dominant_stage"] = dp.get("dominant_stage")
+                    result["starved_workers"] = dp.get("starved")
+                    break
+            except (OSError, ValueError):
+                pass  # master mid-setup; poll again
+        s2 = status(time.time() + 5)
+        if s2 is None:
+            break
+        s = s2
+        if s.finished or s.job_failed:
+            break
+        time.sleep(0.5)
+    result["datapath_event"] = _find_event(obs_dir, "datapath")
+    if result["starved_flagged"]:
+        # Machine-readable dashboard snapshot against the LIVE job (the
+        # chaos schedule is stripped: the dash process is an observer).
+        dash_env = {
+            k: v for k, v in env.items() if k != "ELASTICDL_CHAOS"
+        }
+        try:
+            dash = subprocess.run(
+                [
+                    sys.executable, "-m", "elasticdl_tpu.client.main",
+                    "dash", "--master_addr", f"127.0.0.1:{port}",
+                    "--once", "--json",
+                ],
+                capture_output=True,
+                text=True,
+                timeout=60,
+                env=dash_env,
+                cwd=REPO,
+            )
+            result["dash_json_rc"] = dash.returncode
+            try:
+                snap = json.loads(dash.stdout)
+                result["dash_json_has_datapath"] = bool(
+                    snap.get("datapath")
+                )
+            except ValueError:
+                result["dash_json_has_datapath"] = False
+        except subprocess.TimeoutExpired:
+            result["dash_json_rc"] = -1
+            result["dash_json_has_datapath"] = False
     return s
 
 
@@ -977,7 +1087,10 @@ def main():
             )
         args.num_ps = 0
     obs_dir = args.obs_dir or None
-    needs_obs = args.scenario == "straggler" or args.scenario in POLICY_SCENARIOS
+    needs_obs = (
+        args.scenario in ("straggler", "input-starve")
+        or args.scenario in POLICY_SCENARIOS
+    )
     if needs_obs and not obs_dir:
         import tempfile
 
@@ -1002,6 +1115,15 @@ def main():
     ok = result["completed"] and not result["leftover_procs"]
     if args.scenario == "straggler":
         ok = ok and bool(result.get("straggler_flagged"))
+    elif args.scenario == "input-starve":
+        # The alert must name EXACTLY the faulted worker, the datapath
+        # event trail must exist, and the summary's data-plane block
+        # must blame the injected stage (the slow read surfaces as
+        # producer `read` time and consumer `starve` time).
+        ok = ok and result.get("starved_flagged") == "worker-0"
+        ok = ok and result.get("starved_workers") == ["worker-0"]
+        ok = ok and result.get("datapath_event") is not None
+        ok = ok and result.get("dominant_stage") in ("read", "starve")
     elif args.scenario == "straggler-recovery":
         ok = ok and result.get("decision") is not None
         ok = ok and bool(result.get("recovered"))
