@@ -89,6 +89,12 @@ fleet-smoke: reap
 policy-drill: reap
 	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/test_policy_drill.py -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly
 
+# The master-kill recovery drills (docs/ROBUSTNESS.md "Master recovery"):
+# SIGKILL the master mid-job / mid-scale, relaunch over the same journal,
+# and demand exactly-once records accounting plus the recovery trail.
+master-drill: reap
+	set -o pipefail; timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/test_master_drill.py -q -m chaos -p no:cacheprovider -p no:xdist -p no:randomly
+
 native:
 	@if [ -f elasticdl_tpu/native/Makefile ]; then $(MAKE) -C elasticdl_tpu/native; else echo "native kernels not present yet"; fi
 
@@ -96,16 +102,17 @@ native:
 # even when an earlier one fails (one run answers "what is broken"), and
 # the single trailing CI: line is the machine-readable verdict.
 ci:
-	@lint=FAIL; tier1=FAIL; gate=FAIL; fleet=FAIL; obs=FAIL; policy=FAIL; \
+	@lint=FAIL; tier1=FAIL; gate=FAIL; fleet=FAIL; obs=FAIL; policy=FAIL; master=FAIL; \
 	set -o pipefail; lintlog=$$(mktemp); \
 	$(MAKE) --no-print-directory lint 2>&1 | tee $$lintlog && lint=ok; \
 	$(MAKE) --no-print-directory verify-tests && tier1=ok; \
 	$(MAKE) --no-print-directory fleet-smoke && fleet=ok; \
 	$(MAKE) --no-print-directory obs && obs=ok; \
 	$(MAKE) --no-print-directory policy-drill && policy=ok; \
+	$(MAKE) --no-print-directory master-drill && master=ok; \
 	$(MAKE) --no-print-directory bench-gate && gate=ok; \
 	rules=$$(grep -ao 'per-rule: .*' $$lintlog | tail -1); rm -f $$lintlog; \
-	echo "CI: lint=$$lint tier1=$$tier1 fleet=$$fleet obs=$$obs policy=$$policy bench-gate=$$gate$${rules:+ [$$rules]}"; \
-	[ "$$lint" = ok ] && [ "$$tier1" = ok ] && [ "$$fleet" = ok ] && [ "$$obs" = ok ] && [ "$$policy" = ok ] && [ "$$gate" = ok ]
+	echo "CI: lint=$$lint tier1=$$tier1 fleet=$$fleet obs=$$obs policy=$$policy master=$$master bench-gate=$$gate$${rules:+ [$$rules]}"; \
+	[ "$$lint" = ok ] && [ "$$tier1" = ok ] && [ "$$fleet" = ok ] && [ "$$obs" = ok ] && [ "$$policy" = ok ] && [ "$$master" = ok ] && [ "$$gate" = ok ]
 
-.PHONY: proto test verify verify-tests reap bench-smoke bench-gate lint lint-changed chaos obs fleet-smoke policy-drill native ci
+.PHONY: proto test verify verify-tests reap bench-smoke bench-gate lint lint-changed chaos obs fleet-smoke policy-drill master-drill native ci

@@ -20,12 +20,20 @@ Fault kinds:
                serializer, simulating a torn payload; the client sees a
                deserialization failure (INTERNAL — fail-fast, the worker's
                minibatch retry ladder owns recovery).
+  kill         local injection points only: SIGKILL the OWN process at the
+               matching call index — the deterministic process-crash fault
+               behind the master-kill drills ("master.dispatch" fires at
+               the Nth task dispatch, "master.scale" between the world
+               hint and the scale actuation). Ignored on wire
+               interceptors: killing a process from inside an RPC handler
+               would model nothing a network can do.
 """
 
 import dataclasses
 import json
 import os
 import random
+import signal
 import threading
 import time
 
@@ -39,7 +47,7 @@ logger = get_logger("chaos.injection")
 
 CHAOS_ENV = "ELASTICDL_CHAOS"
 
-KINDS = ("unavailable", "latency", "deadline", "truncate")
+KINDS = ("unavailable", "latency", "deadline", "truncate", "kill")
 
 _INJECTED = default_registry().counter(
     "edl_chaos_injected_total",
@@ -167,10 +175,12 @@ def inject_local(point):
     The interceptors above only reach calls that cross a channel, but
     some drills need to perturb purely in-process code paths — e.g. the
     input-starve scenario slows one worker's record reader by matching
-    rules against the synthetic method name "datapath.read". Same rule
-    grammar (method substring, start/count window, role targeting, seeded
-    jitter); only latency faults make sense here — the other kinds model
-    wire behavior — so anything else on a local point is ignored."""
+    rules against the synthetic method name "datapath.read", and the
+    master-kill drills SIGKILL the master at "master.dispatch" /
+    "master.scale". Same rule grammar (method substring, start/count
+    window, role targeting, seeded jitter); only latency and kill faults
+    make sense here — the other kinds model wire behavior — so anything
+    else on a local point is ignored."""
     schedule = schedule_from_env()
     if schedule is None:
         return
@@ -178,6 +188,13 @@ def inject_local(point):
         if rule.kind == "latency":
             _INJECTED.labels(kind="latency", side="client").inc()
             time.sleep(schedule.jitter(rule))
+        elif rule.kind == "kill":
+            # The deterministic crash fault: no cleanup, no atexit, no
+            # flushing — exactly what a preemption looks like. The metric
+            # bump below is best-effort (the exporter may never scrape it).
+            _INJECTED.labels(kind="kill", side="client").inc()
+            logger.warning("CHAOS: SIGKILL self at local point %r", point)
+            os.kill(os.getpid(), signal.SIGKILL)
 
 
 class ChaosServerInterceptor(grpc.ServerInterceptor):

@@ -297,17 +297,20 @@ def _dash(args):
     exactly one frame and exits — the non-interactive/test mode."""
     import time
 
-    from elasticdl_tpu.common import rpc
+    from elasticdl_tpu.common import knobs, rpc
     from elasticdl_tpu.observability import dashboard
     from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
     import grpc
 
-    stub = rpc.Stub(
-        rpc.build_channel(args.master_addr), rpc.MASTER_SERVICE
-    )
+    channel = rpc.build_channel(args.master_addr)
+    stub = rpc.Stub(channel, rpc.MASTER_SERVICE)
     host = args.master_addr.rsplit(":", 1)[0]
-    errors = 0
+    patience = knobs.get_float("ELASTICDL_MASTER_PATIENCE_SECONDS")
+    unreachable_since = None
+    retry_delay = 0.0
+    incarnation = 0
+    banner = ""
     last_status = None
     polls = 0
     iterations = getattr(args, "iterations", 0)
@@ -329,13 +332,19 @@ def _dash(args):
         polls += 1
         try:
             status = stub.get_job_status(pb.GetJobStatusRequest())
-            errors = 0
+            unreachable_since = None
         except grpc.RpcError as e:
             # The master stops serving right after the job ends (same
             # race _top rides): a job last seen FINISHED must exit 0/1,
-            # not read as a master crash.
-            errors += 1
-            if args.once or errors >= 3:
+            # not read as a master crash. Mid-job, an unreachable master
+            # is most likely RESTARTING (journal replay takes a moment),
+            # so a watch session rides the same patience window the
+            # workers do instead of exiting 1 three polls in. --once
+            # keeps the strict single-probe contract.
+            now = time.time()
+            if args.once or (
+                last_status is not None and last_status.finished
+            ):
                 if last_status is not None and last_status.finished:
                     return 1 if last_status.job_failed else 0
                 print(
@@ -344,8 +353,42 @@ def _dash(args):
                     flush=True,
                 )
                 return 2
-            time.sleep(args.interval)
+            if unreachable_since is None:
+                unreachable_since = now
+                retry_delay = min(args.interval, 1.0)
+                banner = "master unreachable; reconnecting..."
+                print(banner, flush=True)
+            if now - unreachable_since > patience:
+                print(
+                    f"master {args.master_addr} unreachable "
+                    f"({e.code().name})",
+                    flush=True,
+                )
+                return 2
+            time.sleep(retry_delay)
+            retry_delay = min(retry_delay * 1.5, 10.0)
+            # A channel that connect-attempted the unbound port of a
+            # restarting master can stay wedged in UNAVAILABLE after the
+            # port returns — probe, and greet the new master on a FRESH
+            # channel (same recovery the workers use).
+            if rpc.wait_channel_ready(
+                args.master_addr, min(retry_delay, 1.0)
+            ):
+                channel.close()
+                channel = rpc.build_channel(
+                    args.master_addr, ready_timeout=0
+                )
+                stub = rpc.Stub(channel, rpc.MASTER_SERVICE)
             continue
+        inc = getattr(status, "master_incarnation", 0)
+        if incarnation and inc > incarnation:
+            banner = (
+                f"master restarting (incarnation {incarnation}->{inc})"
+            )
+        elif unreachable_since is None:
+            banner = ""
+        if inc:
+            incarnation = inc
         last_status = status
         summary = {}
         if status.metrics_port:
@@ -366,6 +409,8 @@ def _dash(args):
         frame = dashboard.render(
             summary, status, top=getattr(args, "top", 0)
         )
+        if banner:
+            frame = banner + "\n" + frame
         if args.once:
             print(frame, flush=True)
             return 1 if status.job_failed else 0
@@ -385,7 +430,7 @@ def _top(args):
     full dashboard instead of one-line updates."""
     import time
 
-    from elasticdl_tpu.common import rpc
+    from elasticdl_tpu.common import knobs, rpc
     from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
     import grpc
@@ -393,50 +438,84 @@ def _top(args):
     if getattr(args, "watch", False):
         args.once = False
         return _dash(args)
-    stub = rpc.Stub(
-        rpc.build_channel(args.master_addr), rpc.MASTER_SERVICE
-    )
+    channel = rpc.build_channel(args.master_addr)
+    stub = rpc.Stub(channel, rpc.MASTER_SERVICE)
     prev_records, prev_ts = None, None
     first_records, first_ts = None, None
     last_status = None
-    errors = 0
+    patience = knobs.get_float("ELASTICDL_MASTER_PATIENCE_SECONDS")
+    unreachable_since = None
+    retry_delay = 0.0
+    incarnation = 0
     for _ in range(args.iterations) if args.iterations else iter(int, 1):
         try:
             status = stub.get_job_status(pb.GetJobStatusRequest())
         except grpc.RpcError as e:
             # The master stops its server as soon as the job ends, so an
-            # UNAVAILABLE between polls usually means "job over", not an
-            # error. Retry a few times, then report what we last saw.
-            errors += 1
-            if errors < 3:
-                time.sleep(args.interval)
-                continue
+            # UNAVAILABLE between polls against a FINISHED job means
+            # "over", not an error. Mid-job it usually means the master
+            # is restarting (journal replay): ride the same patience
+            # window the workers do, with backoff, instead of giving up
+            # three polls in.
+            now = time.time()
             if last_status is not None and last_status.finished:
                 print(
                     _top_summary_line(
-                        last_status, first_records, first_ts, time.time()
+                        last_status, first_records, first_ts, now
                     ),
                     flush=True,
                 )
                 return 1 if last_status.job_failed else 0
-            if last_status is not None:
-                # Lost the master mid-job: distinct exit code — a crashed
-                # master and a finished job must not look alike to CI.
-                print(
-                    f"master {args.master_addr} gone mid-job "
-                    f"(last: epoch {last_status.epoch}, "
-                    f"v{last_status.model_version}, "
-                    f"records={last_status.records_done})",
-                    flush=True,
-                )
-            else:
+            if unreachable_since is None:
+                unreachable_since = now
+                retry_delay = min(args.interval, 1.0)
                 print(
                     f"master {args.master_addr} unreachable "
-                    f"({e.code().name})",
+                    f"({e.code().name}); retrying for up to "
+                    f"{patience:.0f}s",
                     flush=True,
                 )
-            return 2
-        errors = 0
+            if now - unreachable_since > patience:
+                if last_status is not None:
+                    # Lost the master mid-job for good: distinct exit
+                    # code — a dead master and a finished job must not
+                    # look alike to CI.
+                    print(
+                        f"master {args.master_addr} gone mid-job "
+                        f"(last: epoch {last_status.epoch}, "
+                        f"v{last_status.model_version}, "
+                        f"records={last_status.records_done})",
+                        flush=True,
+                    )
+                else:
+                    print(
+                        f"master {args.master_addr} unreachable "
+                        f"({e.code().name})",
+                        flush=True,
+                    )
+                return 2
+            time.sleep(retry_delay)
+            retry_delay = min(retry_delay * 1.5, 10.0)
+            # Same wedged-channel recovery as _dash: a restarted master
+            # needs a fresh channel, built only once it accepts TCP.
+            if rpc.wait_channel_ready(
+                args.master_addr, min(retry_delay, 1.0)
+            ):
+                channel.close()
+                channel = rpc.build_channel(
+                    args.master_addr, ready_timeout=0
+                )
+                stub = rpc.Stub(channel, rpc.MASTER_SERVICE)
+            continue
+        unreachable_since = None
+        inc = getattr(status, "master_incarnation", 0)
+        if incarnation and inc > incarnation:
+            print(
+                f"master restarting (incarnation {incarnation}->{inc})",
+                flush=True,
+            )
+        if inc:
+            incarnation = inc
         if first_ts is None:
             first_records, first_ts = status.records_done, time.time()
         if last_status is None and status.metrics_port:

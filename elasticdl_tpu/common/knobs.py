@@ -453,6 +453,15 @@ declare("ELASTICDL_TASK_LEASE_BATCH", "int", 1,
         "protocol. Raising it divides dispatch RPC load at fleet "
         "scale.")
 
+# -- master journal (master/journal.py) --
+declare("ELASTICDL_MASTER_JOURNAL_DIR", "str", "",
+        "Directory for the master write-ahead journal + snapshots. Empty "
+        "disables journaling (state is process-local, as before the "
+        "survivable control plane).")
+declare("ELASTICDL_JOURNAL_SNAPSHOT_EVERY", "int", 512,
+        "Compact the master journal into a fresh snapshot after this many "
+        "appended ops (bounds replay time and WAL growth).")
+
 # -- chaos (chaos/injection.py) --
 declare("ELASTICDL_CHAOS", "str", "",
         "JSON fault schedule injected into the rpc plane; set by drills, "

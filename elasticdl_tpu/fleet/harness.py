@@ -391,7 +391,8 @@ class FleetMaster:
 
     def __init__(self, obs_dir, job="fleet", n_records=1 << 20,
                  records_per_task=64, interval=0.5, policy=False,
-                 policy_kwargs=None):
+                 policy_kwargs=None, journal_dir=None,
+                 snapshot_every=None):
         self.job = job
         self.task_d = TaskDispatcher(
             {"fleet": (0, n_records)},
@@ -401,6 +402,31 @@ class FleetMaster:
             num_epochs=1_000_000,
             shuffle=False,
         )
+        # Optional journal plane, wired exactly like the real Master:
+        # restore-then-attach, providers registered before the
+        # snapshot-on-start, incarnation bumped on recovery. This is what
+        # the fleet-scale master-restart drill exercises.
+        self.master_incarnation = 1
+        self.journal = None
+        if journal_dir:
+            from elasticdl_tpu.master.journal import MasterJournal
+
+            self.journal = MasterJournal(
+                journal_dir, snapshot_every=snapshot_every
+            )
+            state = self.journal.load()
+            if state["incarnation"] > 0:
+                self.master_incarnation = state["incarnation"] + 1
+                self.task_d.restore_state(state)
+            self.task_d.attach_journal(self.journal)
+            self.journal.add_state_provider(self.task_d.export_state)
+            self.journal.add_state_provider(
+                lambda: {"incarnation": self.master_incarnation}
+            )
+            self.journal.record(
+                {"op": "incarnation", "value": self.master_incarnation}
+            )
+            self.journal.compact()
         self.servicer = MasterServicer(self.task_d)
         self._server, self.port = rpc.serve(
             self.servicer, rpc.MASTER_SERVICE, port=0
@@ -436,6 +462,7 @@ class FleetMaster:
             aggregator=self.aggregator,
             policy=self.policy,
             world_hints=self.world_hints,
+            master_incarnation=self.master_incarnation,
         )
         self.exporter = MetricsExporter(
             default_registry(), port=0, host="127.0.0.1"
@@ -448,10 +475,16 @@ class FleetMaster:
             summary["policy"] = self.policy.summary()
         return summary
 
-    def close(self):
+    def close(self, crash=False):
+        """Tear down; crash=True models SIGKILL — the gRPC server dies but
+        the journal is NOT cleanly closed (no final snapshot), so whatever
+        the WAL tail holds is exactly what a relaunch replays."""
         self.exporter.close()
         self.aggregator.close()
-        self._server.stop(1)
+        stopped = self._server.stop(0 if crash else 1)
+        if self.journal is not None and not crash:
+            self.journal.close()
+        return stopped
 
 
 class FleetHarness:
@@ -462,7 +495,8 @@ class FleetHarness:
                  push_full_every=16, relay_fanout=16, schedule=None,
                  seed=0, carriers=8, base_step_s=0.05,
                  aggregator_interval=0.5, job="fleet", lease_batch=1,
-                 policy=False, policy_kwargs=None):
+                 policy=False, policy_kwargs=None, journal_dir=None,
+                 master_snapshot_every=None):
         assert mode in ("push", "pull"), mode
         if obs_dir is None:
             import tempfile
@@ -483,6 +517,8 @@ class FleetHarness:
         self.lease_batch = max(1, lease_batch)
         self._policy = policy
         self._policy_kwargs = policy_kwargs
+        self._journal_dir = journal_dir
+        self._master_snapshot_every = master_snapshot_every
         self.policy_decisions = []
         self._n_carriers = max(1, min(carriers, n_workers + n_ps))
         self._relay_fanout = relay_fanout
@@ -552,6 +588,8 @@ class FleetHarness:
             interval=self._agg_interval,
             policy=self._policy,
             policy_kwargs=self._policy_kwargs,
+            journal_dir=self._journal_dir,
+            snapshot_every=self._master_snapshot_every,
         )
         self._channel = rpc.build_channel(f"127.0.0.1:{self.master.port}")
         self.stub = rpc.Stub(self._channel, rpc.MASTER_SERVICE)
@@ -654,6 +692,11 @@ class FleetHarness:
                     self.policy_decisions.extend(
                         self.master.policy.tick()
                     )
+                if self.master.journal is not None:
+                    # Journal maintenance outside every dispatcher/
+                    # provider lock — same placement rule as the real
+                    # master's watchdog tick (MasterJournal.maybe_compact).
+                    self.master.journal.maybe_compact()
             except Exception:
                 logger.warning("fleet master tick failed", exc_info=True)
             self.master_tick_seconds.append(time.perf_counter() - t0)
@@ -665,6 +708,41 @@ class FleetHarness:
         while time.monotonic() < deadline and not self._stop.is_set():
             time.sleep(0.05)
         return self
+
+    def restart_master(self):
+        """Kill the master mid-run (SIGKILL semantics: no journal close,
+        no final snapshot) and bring up a replacement over the same
+        journal dir. Pods keep ticking throughout — their RPCs against
+        the dead endpoint land in rpc_errors, exactly like a real
+        restart — and the harness re-points its shared stub at the new
+        port once replay finishes. Requires journal_dir (a journal-less
+        master would come back with an empty queue and re-dispatch
+        everything)."""
+        assert self._journal_dir, "restart_master needs journal_dir"
+        old = self.master
+        stopped = old.close(crash=True)
+        # Let in-flight handlers drain so the old journal handle cannot
+        # interleave a final append with the successor's WAL writes.
+        stopped.wait(timeout=10.0)
+        old.journal.close()
+        self.count("master_restarts")
+        self.master = FleetMaster(
+            self.obs_dir,
+            job=self.job,
+            interval=self._agg_interval,
+            policy=self._policy,
+            policy_kwargs=self._policy_kwargs,
+            journal_dir=self._journal_dir,
+            snapshot_every=self._master_snapshot_every,
+        )
+        old_channel = self._channel
+        self._channel = rpc.build_channel(
+            f"127.0.0.1:{self.master.port}"
+        )
+        self.stub = rpc.Stub(self._channel, rpc.MASTER_SERVICE)
+        if old_channel is not None:
+            old_channel.close()
+        return self.master
 
     def stats(self):
         with self._count_lock:

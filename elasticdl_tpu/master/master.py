@@ -182,6 +182,53 @@ class Master:
             # Arm the final export task (reference: SavedModel export via a
             # train-end callback task, master/callbacks.py:38-66).
             self.task_d.enable_train_end_task()
+
+        # --- survivable control plane (ELASTICDL_MASTER_JOURNAL_DIR) ---
+        # Replay snapshot+WAL, restore the dispatcher/membership state,
+        # bump the incarnation, and mirror every mutation from here on.
+        # All init-time dispatcher setup above (task creation, checkpoint
+        # fast-forward, train-end arming) happens BEFORE the attach, so
+        # the WAL only ever holds post-start ops (prepare() snapshots the
+        # merged state right before serving).
+        from elasticdl_tpu.master.journal import open_master_journal
+
+        self.journal = open_master_journal()
+        self.master_incarnation = 1
+        self._recovered_state = None
+        self._recovered_leases = []
+        if self.journal is not None:
+            state = self.journal.load()
+            # Every journaled master records its incarnation at startup,
+            # so a nonzero replayed incarnation means a previous life.
+            if state["incarnation"] > 0:
+                self._recovered_state = state
+                self.master_incarnation = state["incarnation"] + 1
+                self.task_d.restore_state(state)
+                self._recovered_leases = self.task_d.inflight_leases()
+                if self.membership is not None:
+                    self.membership.restore_state(state)
+                logger.warning(
+                    "Master journal replayed: incarnation %d, "
+                    "records_done=%d, %d in-flight leases restored, "
+                    "hint_seq=%d",
+                    self.master_incarnation,
+                    state["records_done"],
+                    len(self._recovered_leases),
+                    state["hint_seq"],
+                )
+            self.task_d.attach_journal(self.journal)
+            self.journal.add_state_provider(self.task_d.export_state)
+            if self.membership is not None:
+                self.membership.attach_journal(self.journal)
+                self.journal.add_state_provider(
+                    self.membership.export_state
+                )
+            self.journal.add_state_provider(
+                lambda: {"incarnation": self.master_incarnation}
+            )
+            self.journal.record({
+                "op": "incarnation", "value": self.master_incarnation,
+            })
         self.step_leases = None
         if self.membership is not None and getattr(
             args, "multi_host", False
@@ -361,10 +408,6 @@ class Master:
     # ---------- lifecycle ----------
 
     def prepare(self):
-        self._server, self.port = rpc.serve(
-            self.servicer, rpc.MASTER_SERVICE, port=self.args.master_port
-        )
-        logger.info("Master serving on port %d", self.port)
         # Orphan-reaper beacon: while this file stays fresh the job's
         # process group is alive on purpose; once it goes stale,
         # tools/reap_orphans.py may SIGKILL the whole group.
@@ -399,6 +442,13 @@ class Master:
         )
 
         self.world_hints = WorldHintBoard()
+        if self.journal is not None:
+            # hint_seq survives the restart: a board resuming from 0 would
+            # make trainers silently ignore every post-restart hint.
+            if self._recovered_state is not None:
+                self.world_hints.restore_state(self._recovered_state)
+            self.world_hints.attach_journal(self.journal)
+            self.journal.add_state_provider(self.world_hints.export_state)
         if policy_enabled() and self.aggregator is not None:
             # The closed loop: aggregator signals -> rules -> actuators.
             # Scale decisions announce through the world-hint board first
@@ -408,7 +458,15 @@ class Master:
                 self.task_d,
                 instance_manager=self.instance_manager,
                 world_hints=self.world_hints,
-            ).start()
+            )
+            if self.journal is not None:
+                # Resume without re-firing already-applied decisions:
+                # restored cooldowns keep them suppressed.
+                if self._recovered_state is not None:
+                    self.policy.restore_state(self._recovered_state)
+                self.policy.attach_journal(self.journal)
+                self.journal.add_state_provider(self.policy.export_state)
+            self.policy.start()
             if self.obs.exporter is not None:
                 self.obs.exporter.summary_provider = self._summary
         self.servicer.bind_job_context(
@@ -417,7 +475,49 @@ class Master:
             aggregator=self.aggregator,
             policy=self.policy,
             world_hints=self.world_hints,
+            master_incarnation=self.master_incarnation,
         )
+        if self.journal is not None:
+            # Snapshot-on-start: fold the replayed (or fresh) state of
+            # every provider into snapshot.json and truncate the WAL, so
+            # replay time is bounded by post-start activity only.
+            self.journal.compact()
+        if self._recovered_state is not None:
+            # Re-lease trail: owners that reappear within the liveness
+            # window keep their restored leases (seed_liveness grants the
+            # grace); the watchdog sweeps the rest back to the queue.
+            owners = sorted({
+                wid for _, wid, _ in self._recovered_leases
+            })
+            self.servicer.seed_liveness(owners)
+            observability.emit_event(
+                "master_recovered",
+                incarnation=self.master_incarnation,
+                records_done=self._recovered_state["records_done"],
+                leases=len(self._recovered_leases),
+                hint_seq=self._recovered_state["hint_seq"],
+                membership_epoch=self._recovered_state[
+                    "membership_epoch"
+                ],
+            )
+            for tid, wid, task in self._recovered_leases:
+                observability.emit_event(
+                    "lease_reissued",
+                    task_id=tid,
+                    worker=wid,
+                    shard=task.shard_name,
+                    start=task.start,
+                    end=task.end,
+                )
+        # Bind the port LAST: the first RPC any client can land must
+        # already see the recovered world — bumped incarnation in
+        # JobStatusResponse, restored hint board, seeded liveness. A
+        # master that serves while still wiring recovery shows a
+        # regressed hint_seq/incarnation window to riding workers.
+        self._server, self.port = rpc.serve(
+            self.servicer, rpc.MASTER_SERVICE, port=self.args.master_port
+        )
+        logger.info("Master serving on port %d", self.port)
         if self.instance_manager is not None:
             if self.args.num_ps:
                 self.instance_manager.start_parameter_servers()
@@ -498,6 +598,12 @@ class Master:
                 ):
                     last_watchdog = now
                     self._run_watchdog()
+                    # Journal maintenance rides the watchdog tick: this
+                    # thread holds no dispatcher/policy lock here, which
+                    # compaction requires (it calls back into the state
+                    # providers — see MasterJournal.maybe_compact).
+                    if self.journal is not None:
+                        self.journal.maybe_compact()
                 if self.metrics_service and now - last_metrics >= 30.0:
                     stats = self.task_d.stats()
                     elapsed = now - last_metrics
@@ -588,6 +694,9 @@ class Master:
             self.metrics_service.close()
         if self._server is not None:
             self._server.stop(2)
+        if getattr(self, "journal", None) is not None:
+            self.journal.close()
+            self.journal = None
         # Flush + release the per-process trace/event files so a monitor
         # reading them right after exit sees complete lines; also resets
         # the process-global handle for in-process tests that run several

@@ -49,6 +49,25 @@ class MembershipManager:
         self._group_id = 0
         self._coordinator_port = coordinator_port
         self._arrivals = {}  # epoch -> set of hosts at the join gate
+        self._journal = None  # epoch bumps are journaled (PR 19)
+
+    def attach_journal(self, journal):
+        with self._lock:
+            self._journal = journal
+
+    def restore_state(self, state):
+        """Resume the epoch counter past the journaled high-water mark so
+        a relaunched master never re-issues an already-used group_id (the
+        coordinator-port rotation and the arrive() gate both key on it)."""
+        with self._lock:
+            self._group_id = max(
+                self._group_id, int(state.get("membership_epoch", 0))
+            )
+            _EPOCH.set(self._group_id)
+
+    def export_state(self):
+        with self._lock:
+            return {"membership_epoch": self._group_id}
 
     def set_worker_hosts(self, hosts):
         """Replace the alive-host set (called by the instance manager on pod
@@ -68,6 +87,11 @@ class MembershipManager:
 
     def _epoch_changed_locked(self, cause):
         t0 = time.perf_counter()
+        if self._journal is not None:
+            self._journal.record({
+                "op": "membership_epoch",
+                "group_id": self._group_id,
+            })
         _EPOCH.set(self._group_id)
         _WORLD.set(len(self._hosts))
         emit_event(

@@ -42,6 +42,7 @@ import re
 import threading
 import time
 
+from elasticdl_tpu.chaos import injection
 from elasticdl_tpu.common import knobs
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.observability import emit_event
@@ -75,7 +76,12 @@ class WorldHintBoard:
     """The master-driven half of the world-hint RPC: the policy engine
     announces the target worker world BEFORE actuating a scale event;
     workers poll get_world_hint and speculatively compile the announced
-    world. hint_seq is monotonic; 0 means nothing was ever announced."""
+    world. hint_seq is monotonic; 0 means nothing was ever announced.
+
+    The seq survives master restarts: a journal-recovered board resumes
+    from the replayed seq (restore_state) and every announce is journaled
+    — a board restarting at 0 would make trainers silently ignore every
+    post-restart hint as stale."""
 
     def __init__(self, time_fn=time.time):
         self._lock = threading.Lock()
@@ -84,6 +90,29 @@ class WorldHintBoard:
         self._target = 0
         self._reason = ""
         self._ts = 0.0
+        self._journal = None
+
+    def attach_journal(self, journal):
+        with self._lock:
+            self._journal = journal
+
+    def restore_state(self, state):
+        """Resume from a replayed journal state (hint_seq monotonicity
+        across incarnations)."""
+        with self._lock:
+            self._seq = max(self._seq, int(state.get("hint_seq", 0)))
+            self._target = int(state.get("hint_target", 0))
+            self._reason = str(state.get("hint_reason", ""))
+            if self._seq:
+                self._ts = self._time()
+
+    def export_state(self):
+        with self._lock:
+            return {
+                "hint_seq": self._seq,
+                "hint_target": self._target,
+                "hint_reason": self._reason,
+            }
 
     def announce(self, target_world_size, reason=""):
         with self._lock:
@@ -92,9 +121,22 @@ class WorldHintBoard:
             self._reason = reason
             self._ts = self._time()
             seq = self._seq
+            if self._journal is not None:
+                # Write-ahead: the hint is durable BEFORE any worker can
+                # observe it, so a crash between announce and actuation
+                # cannot regress hint_seq on recovery.
+                self._journal.record({
+                    "op": "hint",
+                    "seq": seq,
+                    "target": int(target_world_size),
+                    "reason": reason[:200],
+                })
         emit_event(
             "world_hint",
-            seq=seq,
+            # Named hint_seq, NOT seq: the event envelope stamps its own
+            # `seq` (file order) over the payload, which would silently
+            # shadow the hint's sequence number.
+            hint_seq=seq,
             target_world_size=int(target_world_size),
             reason=reason[:200],
         )
@@ -191,6 +233,7 @@ class PolicyEngine:
 
         self._counters = {}  # (rule, subject) -> consecutive trigger ticks
         self._cooldowns = {}  # (action, subject) -> last applied ts
+        self._journal = None  # applied decisions journal their cooldowns
         self._applied_window = collections.deque()  # applied-action stamps
         self._recent = collections.deque(maxlen=64)  # decision dicts
         self._actions_total = 0  # APPLIED actions only
@@ -198,6 +241,32 @@ class PolicyEngine:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = None
+
+    # ---------- journal plane ----------
+
+    def attach_journal(self, journal):
+        self._journal = journal
+
+    def restore_state(self, state):
+        """Resume without re-firing decisions already applied: the
+        journaled (action, subject) -> ts cooldown map is restored, so a
+        decision applied just before the crash stays in cooldown after
+        the relaunch instead of firing again."""
+        cooldowns = {}
+        for key, ts in (state.get("cooldowns") or {}).items():
+            action, _, subject = key.partition("|")
+            cooldowns[(action, subject)] = float(ts)
+        with self._lock:
+            self._cooldowns.update(cooldowns)
+
+    def export_state(self):
+        with self._lock:
+            return {
+                "cooldowns": {
+                    f"{action}|{subject}": ts
+                    for (action, subject), ts in self._cooldowns.items()
+                },
+            }
 
     # ---------- lifecycle ----------
 
@@ -278,7 +347,14 @@ class PolicyEngine:
             try:
                 actuate()
                 outcome = "applied"
-                self._cooldowns[cd_key] = now
+                with self._lock:
+                    self._cooldowns[cd_key] = now
+                if self._journal is not None:
+                    self._journal.record({
+                        "op": "cooldown",
+                        "key": f"{action}|{subject}",
+                        "ts": now,
+                    })
                 self._applied_window.append(now)
                 with self._lock:
                     self._actions_total += 1
@@ -466,6 +542,9 @@ class PolicyEngine:
         # regroup consumes a prebuilt executable (aot_consumed).
         if self._world_hints is not None:
             self._world_hints.announce(target_world, reason)
+        # Chaos seam for the master-kill-during-scale drill: the hint is
+        # journaled/announced but the actuation below never happens.
+        injection.inject_local("master.scale")
         self._instance_manager.scale_workers(delta, reason)
 
     # ---------- status ----------

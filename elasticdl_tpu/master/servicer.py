@@ -11,6 +11,7 @@ import os
 import threading
 import time
 
+from elasticdl_tpu.chaos import injection
 from elasticdl_tpu.common import tensor_utils
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.observability import tracing
@@ -47,6 +48,10 @@ class MasterServicer:
         self._aggregator = None
         self._policy = None
         self._world_hints = None
+        # Monotonic master incarnation (journal-recovered masters bump it)
+        # stamped into JobStatusResponse so clients and workers can tell a
+        # restart from a stall. 1 = first (or journal-less) life.
+        self.master_incarnation = 1
 
     def bind_job_context(
         self,
@@ -55,6 +60,7 @@ class MasterServicer:
         aggregator=None,
         policy=None,
         world_hints=None,
+        master_incarnation=None,
     ):
         """Late-bind job-status sources created after this servicer."""
         self._instance_manager = instance_manager
@@ -62,6 +68,8 @@ class MasterServicer:
         self._aggregator = aggregator
         self._policy = policy
         self._world_hints = world_hints
+        if master_incarnation is not None:
+            self.master_incarnation = master_incarnation
 
     def _touch(self, worker_id):
         with self._lock:
@@ -77,10 +85,24 @@ class MasterServicer:
         with self._lock:
             self.worker_liveness.pop(worker_id, None)
 
+    def seed_liveness(self, worker_ids):
+        """Grant recovery-time grace to workers that held journaled leases:
+        a reappearing owner's next RPC refreshes this stamp and keeps its
+        re-issued lease; one that never reappears ages out and the normal
+        watchdog sweeps its tasks back to the queue."""
+        now = time.time()
+        with self._lock:
+            for wid in worker_ids:
+                self.worker_liveness.setdefault(wid, now)
+
     # ---------- rpc methods (names match rpc.MASTER_SERVICE) ----------
 
     def get_task(self, request, context):
         self._touch(request.worker_id)
+        # Deterministic crash seam for the master-kill drill: a chaos
+        # "kill" rule on this point SIGKILLs the master at the Nth
+        # dispatch, BEFORE any lease is issued for this call.
+        injection.inject_local("master.dispatch")
         if request.task_type == pb.EVALUATION:
             task_id, task = self._task_d.get_eval_task(request.worker_id)
         else:
@@ -99,18 +121,26 @@ class MasterServicer:
         tracing.instant(
             "dispatch_task", task_id=task_id, worker=request.worker_id
         )
-        return task.to_proto(task_id)
+        return self._stamp_lease(task.to_proto(task_id))
+
+    def _stamp_lease(self, task_pb):
+        """Stamp the dispatcher's lease token into an outgoing Task proto
+        so the worker can echo it with the result (exactly-once reporting
+        across master restarts)."""
+        task_pb.lease_token = self._task_d.lease_token(task_pb.task_id)
+        return task_pb
 
     def get_task_batch(self, request, context):
         """Lease batching: up to max_tasks tasks in one RPC. An empty
         batch with finished=False is the WAIT analog."""
         self._touch(request.worker_id)
+        injection.inject_local("master.dispatch")
         leased = self._task_d.get_batch(
             request.worker_id, max(1, request.max_tasks)
         )
         res = pb.TaskBatch()
         for task_id, task in leased:
-            res.tasks.append(task.to_proto(task_id))
+            res.tasks.append(self._stamp_lease(task.to_proto(task_id)))
             tracing.instant(
                 "dispatch_task", task_id=task_id, worker=request.worker_id
             )
@@ -120,14 +150,18 @@ class MasterServicer:
 
     def report_task_result(self, request, context):
         success = not request.err_message
-        self._task_d.report(request.task_id, success, request.err_message)
+        self._task_d.report(
+            request.task_id, success, request.err_message,
+            lease_token=request.lease_token,
+        )
         return pb.Empty()
 
     def report_task_results(self, request, context):
         """Batched analog of report_task_result."""
         for entry in request.results:
             self._task_d.report(
-                entry.task_id, not entry.err_message, entry.err_message
+                entry.task_id, not entry.err_message, entry.err_message,
+                lease_token=entry.lease_token,
             )
         return pb.Empty()
 
@@ -248,6 +282,7 @@ class MasterServicer:
             tasks_recovered=stats.get("tasks_recovered", 0),
             tasks_abandoned=stats.get("tasks_abandoned", 0),
             metrics_port=self._metrics_port,
+            master_incarnation=self.master_incarnation,
         )
         if self._instance_manager is not None:
             res.relaunches = self._instance_manager.total_relaunches()

@@ -107,6 +107,20 @@ class _Task:
         )
 
 
+def _task_to_tuple(task):
+    """Journal wire form of a task: a plain JSON list (see journal.py)."""
+    return [
+        task.shard_name, task.start, task.end, int(task.type),
+        task.model_version, task.retry_count,
+    ]
+
+
+def _task_from_tuple(t):
+    task = _Task(t[0], t[1], t[2], t[3], t[4])
+    task.retry_count = t[5]
+    return task
+
+
 class TaskDispatcher:
     """Thread-safe todo/doing task queues with elastic recovery."""
 
@@ -159,6 +173,13 @@ class TaskDispatcher:
         self._retired_twins = set()
         self._backups_launched = 0
         self._backup_wins = 0
+        # Survivable control plane (PR 19): monotonic lease tokens defend
+        # result reports across a master restart, and every mutation below
+        # is mirrored into the attached write-ahead journal BEFORE the RPC
+        # ack (attach_journal). No journal attached -> zero overhead.
+        self._journal = None
+        self._next_lease_token = 0
+        self._lease_tokens = {}  # task_id -> token, lives with _doing
 
         if self._training_shards:
             logger.info("Starting epoch 0")
@@ -168,6 +189,112 @@ class TaskDispatcher:
             self._create_tasks_locked(pb.EVALUATION)
         elif self._prediction_shards:
             self._create_tasks_locked(pb.PREDICTION)
+
+    # ---------- journal plane ----------
+
+    def attach_journal(self, journal):
+        """Mirror every mutation into the write-ahead journal from now on.
+
+        Call AFTER construction-time setup (initial task creation,
+        set_completed_records fast-forward, restore_state): the caller
+        snapshots immediately after attaching, so the WAL only ever holds
+        post-start ops and replay never has to re-derive RNG shuffles."""
+        with self._lock:
+            self._journal = journal
+
+    def _j(self, op):
+        """Append one op to the journal (write-ahead: callers hold the
+        dispatch lock, so the op lands before the RPC ack leaves)."""
+        if self._journal is not None:
+            self._journal.record(op)
+
+    def lease_token(self, task_id):
+        """The token stamped into the dispatched Task proto (0 = no lease)."""
+        with self._lock:
+            return self._lease_tokens.get(task_id, 0)
+
+    def export_state(self):
+        """Journal-snapshot slice of the dispatcher state (journal.py's
+        vocabulary; JSON-safe)."""
+        with self._lock:
+            return {
+                "next_task_id": self._next_task_id,
+                "next_lease_token": self._next_lease_token,
+                "epoch": self._epoch,
+                "todo": [_task_to_tuple(t) for t in self._todo],
+                "doing": {
+                    str(tid): {
+                        "worker": wid,
+                        "task": _task_to_tuple(task),
+                        "token": self._lease_tokens.get(tid, 0),
+                    }
+                    for tid, (wid, task, _) in self._doing.items()
+                },
+                "records_done": self._records_done,
+                "tasks_recovered": self._tasks_recovered,
+                "tasks_abandoned": self._tasks_abandoned,
+                "job_failed": self._job_failed,
+                "stop_training": self._stop_training,
+                "train_end_pending": self._train_end_pending,
+                "twins": {str(k): v for k, v in self._twins.items()},
+                "backup_ids": sorted(self._backup_ids),
+                "retired_twins": sorted(self._retired_twins),
+                "backups_launched": self._backups_launched,
+                "backup_wins": self._backup_wins,
+                "blacklist": {
+                    str(wid): [expires_at, reason]
+                    for wid, (expires_at, reason) in self._blacklist.items()
+                },
+            }
+
+    def restore_state(self, state):
+        """Load a replayed journal state (journal.replay output). In-flight
+        leases are restored with a RECOVERY-TIME start so the watchdog
+        grants reappearing owners a fresh grace window and sweeps the rest;
+        the caller emits the lease_reissued trail."""
+        now = time.time()
+        with self._lock:
+            self._epoch = int(state["epoch"])
+            self._next_task_id = int(state["next_task_id"])
+            self._next_lease_token = int(state["next_lease_token"])
+            self._todo = collections.deque(
+                _task_from_tuple(t) for t in state["todo"]
+            )
+            self._doing = {}
+            self._lease_tokens = {}
+            for tid, entry in state["doing"].items():
+                tid = int(tid)
+                self._doing[tid] = (
+                    entry["worker"], _task_from_tuple(entry["task"]), now
+                )
+                self._lease_tokens[tid] = int(entry.get("token", 0))
+            self._records_done = int(state["records_done"])
+            self._tasks_recovered = int(state["tasks_recovered"])
+            self._tasks_abandoned = int(state["tasks_abandoned"])
+            self._job_failed = bool(state["job_failed"])
+            self._stop_training = bool(state["stop_training"])
+            self._train_end_pending = bool(state["train_end_pending"])
+            self._twins = {
+                int(k): int(v) for k, v in state.get("twins", {}).items()
+            }
+            self._backup_ids = set(state.get("backup_ids", []))
+            self._retired_twins = set(state.get("retired_twins", []))
+            self._backups_launched = int(state.get("backups_launched", 0))
+            self._backup_wins = int(state.get("backup_wins", 0))
+            self._blacklist = {
+                int(wid): (float(v[0]), str(v[1]))
+                for wid, v in state.get("blacklist", {}).items()
+            }
+            _BLACKLISTED.set(len(self._blacklist))
+            self._gauges_locked()
+
+    def inflight_leases(self):
+        """[(task_id, worker_id, _Task)] snapshot, for the recovery trail."""
+        with self._lock:
+            return [
+                (tid, wid, task)
+                for tid, (wid, task, _) in self._doing.items()
+            ]
 
     # ---------- task creation ----------
 
@@ -193,6 +320,12 @@ class TaskDispatcher:
             self._todo.extend(tasks)
         self._gauges_locked()
         if tasks:
+            self._j({
+                "op": "tasks_created",
+                "epoch": self._epoch,
+                "at_front": at_front,
+                "tasks": [_task_to_tuple(t) for t in tasks],
+            })
             emit_event(
                 "task_create",
                 type=_type_name(task_type),
@@ -294,6 +427,10 @@ class TaskDispatcher:
         lazily inside finished() so it cannot be picked up mid-epoch."""
         with self._lock:
             self._train_end_pending = bool(self._training_shards)
+            self._j({
+                "op": "train_end_enabled",
+                "pending": self._train_end_pending,
+            })
 
     # ---------- worker-facing operations ----------
 
@@ -329,6 +466,15 @@ class TaskDispatcher:
                 task_id = self._next_task_id
                 self._next_task_id += 1
                 self._doing[task_id] = (worker_id, task, time.time())
+                self._next_lease_token += 1
+                self._lease_tokens[task_id] = self._next_lease_token
+                self._j({
+                    "op": "lease",
+                    "task_id": task_id,
+                    "worker": worker_id,
+                    "task": _task_to_tuple(task),
+                    "token": self._next_lease_token,
+                })
                 _DISPATCHED.labels(type=_type_name(task.type)).inc()
                 self._gauges_locked()
                 return task_id, task
@@ -378,6 +524,15 @@ class TaskDispatcher:
                         self._doing[task_id] = (
                             worker_id, task, time.time()
                         )
+                        self._next_lease_token += 1
+                        self._lease_tokens[task_id] = self._next_lease_token
+                        self._j({
+                            "op": "lease",
+                            "task_id": task_id,
+                            "worker": worker_id,
+                            "task": _task_to_tuple(task),
+                            "token": self._next_lease_token,
+                        })
                         _DISPATCHED.labels(
                             type=_type_name(task.type)
                         ).inc()
@@ -409,9 +564,14 @@ class TaskDispatcher:
         unblacklist_worker is called. In-flight tasks are untouched (the
         caller decides whether to recover them)."""
         with self._lock:
-            self._blacklist[worker_id] = (
-                time.time() + max(ttl_seconds, 0.0), reason
-            )
+            until = time.time() + max(ttl_seconds, 0.0)
+            self._blacklist[worker_id] = (until, reason)
+            self._j({
+                "op": "blacklist",
+                "worker": worker_id,
+                "until": until,
+                "reason": reason[:200],
+            })
             _BLACKLISTED.set(len(self._blacklist))
         emit_event(
             "worker_blacklist",
@@ -427,6 +587,8 @@ class TaskDispatcher:
     def unblacklist_worker(self, worker_id):
         with self._lock:
             removed = self._blacklist.pop(worker_id, None) is not None
+            if removed:
+                self._j({"op": "unblacklist", "worker": worker_id})
             _BLACKLISTED.set(len(self._blacklist))
         if removed:
             emit_event("worker_blacklist", worker=worker_id, cleared=True)
@@ -507,6 +669,16 @@ class TaskDispatcher:
             self._twins[backup_id] = primary_id
             self._backup_ids.add(backup_id)
             self._backups_launched += 1
+            self._next_lease_token += 1
+            self._lease_tokens[backup_id] = self._next_lease_token
+            self._j({
+                "op": "backup_lease",
+                "task_id": backup_id,
+                "primary_id": primary_id,
+                "worker": worker_id,
+                "task": _task_to_tuple(task),
+                "token": self._next_lease_token,
+            })
             _DISPATCHED.labels(type=_type_name(task.type)).inc()
             _BACKUPS.labels(outcome="dispatched").inc()
             self._gauges_locked()
@@ -523,12 +695,13 @@ class TaskDispatcher:
 
     def _resolve_twin_locked(self, task_id, success):
         """First-result-wins bookkeeping for a reported copy of a twinned
-        task. Returns "win" (count this report's records), "lone_failure"
-        (no live twin: run the normal retry ladder), or "copy_failed"
-        (this copy failed but its twin is still racing: discard)."""
+        task. Returns (verdict, twin_id): "win" (count this report's
+        records), "lone_failure" (no live twin: run the normal retry
+        ladder), or "copy_failed" (this copy failed but its twin is still
+        racing: discard). twin_id is the retired twin, None when untwinned."""
         twin_id = self._twins.pop(task_id, None)
         if twin_id is None:
-            return "win" if success else "lone_failure"
+            return ("win" if success else "lone_failure"), None
         self._twins.pop(twin_id, None)
         if success:
             # Retire the losing copy: its in-flight entry leaves _doing
@@ -536,6 +709,7 @@ class TaskDispatcher:
             if self._doing.pop(twin_id, None) is not None:
                 self._retired_twins.add(twin_id)
                 self._backup_ids.discard(twin_id)
+                self._lease_tokens.pop(twin_id, None)
             self._backup_wins += 1
             outcome = (
                 "backup_win" if task_id in self._backup_ids
@@ -548,7 +722,7 @@ class TaskDispatcher:
                 twin=twin_id,
                 phase=outcome,
             )
-            return "win"
+            return "win", twin_id
         # This copy failed but the twin is still running: the twin owns
         # the work now (requeueing here would triple-run the range).
         _BACKUPS.labels(outcome="copy_failed").inc()
@@ -556,21 +730,40 @@ class TaskDispatcher:
             "backup_task", task_id=task_id, twin=twin_id,
             phase="copy_failed",
         )
-        return "copy_failed"
+        return "copy_failed", twin_id
 
-    def report(self, task_id, success, err_message=""):
+    def report(self, task_id, success, err_message="", lease_token=0):
         """Worker finished (or failed) a task. Failed tasks are re-queued at
-        the front until retries are exhausted, which fails the job."""
+        the front until retries are exhausted, which fails the job.
+
+        lease_token defends exactly-once accounting across master restarts:
+        a nonzero token that mismatches the stored lease is a report for a
+        lease this incarnation never issued (or already resolved) — it is
+        acknowledged and discarded. Token 0 is the legacy/no-journal path
+        and is always accepted."""
         t0 = time.perf_counter()
         try:
-            return self._report_timed(task_id, success, err_message)
+            return self._report_timed(task_id, success, err_message,
+                                      lease_token)
         finally:
             _DISPATCH_SECONDS.labels(op="report").observe(
                 time.perf_counter() - t0
             )
 
-    def _report_timed(self, task_id, success, err_message=""):
+    def _report_timed(self, task_id, success, err_message="", lease_token=0):
         with self._lock:
+            if lease_token:
+                stored = self._lease_tokens.get(task_id)
+                if stored is not None and stored != lease_token:
+                    # Stale lease: the report belongs to a superseded lease
+                    # of the same task id (re-issued after recovery). Ack
+                    # and discard — the live lease owns the accounting.
+                    _REPORTED.labels(result="stale_lease").inc()
+                    emit_event(
+                        "task_stale_lease", task_id=task_id,
+                        token=lease_token, expected=stored,
+                    )
+                    return None
             entry = self._doing.pop(task_id, None)
             if entry is None:
                 if task_id in self._retired_twins:
@@ -586,11 +779,13 @@ class TaskDispatcher:
                     return None
                 logger.warning("Unknown task id reported: %d", task_id)
                 return None
+            self._lease_tokens.pop(task_id, None)
             worker_id, task, start_time = entry
-            verdict = self._resolve_twin_locked(task_id, success)
+            verdict, twin_id = self._resolve_twin_locked(task_id, success)
             self._backup_ids.discard(task_id)
             if verdict == "copy_failed":
                 # Failed copy of a still-racing twin: no retry ladder.
+                self._j({"op": "dropped", "task_id": task_id})
                 self._gauges_locked()
                 return task
             if success:
@@ -600,10 +795,21 @@ class TaskDispatcher:
                 ).append(time.time() - start_time)
                 if task.type == pb.TRAINING:
                     self._records_done += task.end - task.start
+                self._j({
+                    "op": "done",
+                    "task_id": task_id,
+                    "records": (
+                        task.end - task.start
+                        if task.type == pb.TRAINING else 0
+                    ),
+                    "retire_twin": twin_id,
+                    "backup_win": twin_id is not None,
+                })
                 evaluation_done = task.type == pb.EVALUATION
                 job_done = self._finished_locked()
             elif self._stop_training and task.type == pb.TRAINING:
                 # Early stop: don't resurrect failed training tasks.
+                self._j({"op": "dropped", "task_id": task_id})
                 evaluation_done = False
                 job_done = self._finished_locked()
             else:
@@ -640,6 +846,11 @@ class TaskDispatcher:
                         error=err_message[:200],
                     )
                     self._todo.appendleft(task)
+                    self._j({
+                        "op": "failed_requeue",
+                        "task_id": task_id,
+                        "task": _task_to_tuple(task),
+                    })
                 evaluation_done = False
                 job_done = False
             self._gauges_locked()
@@ -668,9 +879,12 @@ class TaskDispatcher:
             ]
             for tid in ids:
                 _, task, _ = self._doing.pop(tid)
+                self._lease_tokens.pop(tid, None)
                 if self._drop_copy_if_twinned_locked(tid):
+                    self._j({"op": "dropped", "task_id": tid})
                     continue
                 if self._stop_training and task.type == pb.TRAINING:
+                    self._j({"op": "dropped", "task_id": tid})
                     continue
                 task.retry_count += 1
                 if task.retry_count > self._max_task_retries:
@@ -679,6 +893,11 @@ class TaskDispatcher:
                     self._todo.clear()
                 else:
                     self._todo.appendleft(task)
+                    self._j({
+                        "op": "failed_requeue",
+                        "task_id": tid,
+                        "task": _task_to_tuple(task),
+                    })
             self._gauges_locked()
         for task in failed:
             logger.error(
@@ -726,6 +945,11 @@ class TaskDispatcher:
         distinguish from slow progress."""
         self._tasks_abandoned += 1
         self._job_failed = True
+        self._j({
+            "op": "abandoned",
+            "task_id": task_id,
+            "job_failed": True,
+        })
         _ABANDONED.inc()
         emit_event(
             "task_abandoned",
@@ -749,16 +973,29 @@ class TaskDispatcher:
                 if wid == worker_id
             ]
             requeued = 0
+            recovered_ids, recovered_tasks = [], []
             for tid in ids:
                 _, task, _ = self._doing.pop(tid)
+                self._lease_tokens.pop(tid, None)
                 if self._drop_copy_if_twinned_locked(tid):
                     # A copy of a still-racing twin dies with its worker:
                     # the surviving copy owns the work, nothing to requeue.
+                    self._j({"op": "dropped", "task_id": tid})
                     continue
                 if self._stop_training and task.type == pb.TRAINING:
+                    self._j({"op": "dropped", "task_id": tid})
                     continue
                 self._todo.appendleft(task)
                 requeued += 1
+                recovered_ids.append(tid)
+                recovered_tasks.append(_task_to_tuple(task))
+            if recovered_ids:
+                self._j({
+                    "op": "recovered",
+                    "worker": worker_id,
+                    "task_ids": recovered_ids,
+                    "tasks": recovered_tasks,
+                })
             self._tasks_recovered += requeued
             self._gauges_locked()
         if requeued:
@@ -788,7 +1025,12 @@ class TaskDispatcher:
             # worker completes it.
             self._train_end_pending = False
             name = next(iter(self._training_shards))
-            self._todo.append(_Task(name, 0, 0, pb.TRAIN_END_CALLBACK))
+            task = _Task(name, 0, 0, pb.TRAIN_END_CALLBACK)
+            self._todo.append(task)
+            self._j({
+                "op": "train_end_consumed",
+                "task": _task_to_tuple(task),
+            })
             logger.info("Dispatching train-end callback task")
             return False
         return done
@@ -833,6 +1075,10 @@ class TaskDispatcher:
             self._todo = collections.deque(
                 t for t in self._todo if t.type != pb.TRAINING
             )
+            self._j({
+                "op": "stop_training",
+                "training_type": int(pb.TRAINING),
+            })
 
     def doing_tasks_over_timeout(self, factor=3.0, min_samples=5):
         """Worker ids whose in-flight task has run > factor x the rolling mean

@@ -1,6 +1,8 @@
 """Worker-side wrapper over the Master service stub (reference
 /root/reference/elasticdl/python/worker/master_client.py:20-117)."""
 
+import threading
+
 import numpy as np
 
 from elasticdl_tpu.common import rpc, tensor_utils
@@ -9,10 +11,33 @@ from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
 class MasterClient:
     def __init__(self, master_addr, worker_id, worker_host=""):
+        self._addr = master_addr
+        self._reconnect_lock = threading.Lock()
         self._channel = rpc.build_channel(master_addr)
         self._stub = rpc.Stub(self._channel, rpc.MASTER_SERVICE)
         self._worker_id = worker_id
         self._worker_host = worker_host
+
+    def reconnect(self, probe_timeout=1.0):
+        """Tear down and rebuild the channel once the master accepts TCP
+        again. A channel that connect-attempted the unbound port of a
+        restarting master can wedge in UNAVAILABLE even after the port
+        returns (the failure mode rpc.build_channel's readiness probe
+        exists for) — riding out a master restart therefore needs a FRESH
+        channel, probed only after the peer is really back. Returns True
+        when the swap happened; False (channel untouched) while the
+        master is still unreachable. Safe from any thread: every stub
+        call reads self._stub at call time, so in-flight users migrate on
+        their next call and the old channel's failures stay on the old
+        channel."""
+        with self._reconnect_lock:
+            if not rpc.wait_channel_ready(self._addr, probe_timeout):
+                return False
+            old = self._channel
+            self._channel = rpc.build_channel(self._addr, ready_timeout=0)
+            self._stub = rpc.Stub(self._channel, rpc.MASTER_SERVICE)
+            old.close()
+            return True
 
     @property
     def worker_host(self):
@@ -45,11 +70,18 @@ class MasterClient:
 
     def report_task_results(self, results):
         """Batch-report task results. results: iterable of
-        (task_id, err_message, exec_counters) tuples."""
+        (task_id, err_message, exec_counters) or
+        (task_id, err_message, exec_counters, lease_token) tuples; the
+        token (when the dispatched Task carried one) makes the report
+        exactly-once across a master restart."""
         req = pb.ReportTaskResultsRequest()
-        for task_id, err_message, exec_counters in results:
+        for result in results:
+            task_id, err_message, exec_counters = result[:3]
+            lease_token = result[3] if len(result) > 3 else 0
             entry = req.results.add(
-                task_id=task_id, err_message=err_message or ""
+                task_id=task_id,
+                err_message=err_message or "",
+                lease_token=lease_token,
             )
             if exec_counters:
                 for k, v in exec_counters.items():
@@ -63,9 +95,11 @@ class MasterClient:
             pb.GetWorldHintRequest(worker_id=self._worker_id)
         )
 
-    def report_task_result(self, task_id, err_message="", exec_counters=None):
+    def report_task_result(self, task_id, err_message="", exec_counters=None,
+                           lease_token=0):
         req = pb.ReportTaskResultRequest(
-            task_id=task_id, err_message=err_message
+            task_id=task_id, err_message=err_message,
+            lease_token=lease_token,
         )
         if exec_counters:
             for k, v in exec_counters.items():

@@ -43,12 +43,23 @@ _CONNECTIVITY_CODES = (
 )
 
 
-def _ride_master_outage(call, what, give_up=None):
+# A sustained outage usually means the master PROCESS restarted (not a
+# network blip) — and a grpc channel whose reconnect attempts hit the
+# unbound port can wedge in UNAVAILABLE forever (see MasterClient.
+# reconnect). After this long unreachable, start probing for the new
+# master and swap to a fresh channel the moment it accepts.
+_RECONNECT_AFTER_SECONDS = 5.0
+
+
+def _ride_master_outage(call, what, give_up=None, reconnect=None):
     """Run `call()`, re-trying through connectivity failures for up to the
     patience window. On exhaustion: `give_up(error)` when provided (drop
     semantics), else re-raise. Non-connectivity errors propagate
-    immediately."""
+    immediately. `reconnect()` (when provided) is invoked periodically
+    during a sustained outage so the transport can be rebuilt against a
+    restarted master."""
     unreachable_since = None
+    last_reconnect = 0.0
     while True:
         try:
             return call()
@@ -70,6 +81,19 @@ def _ride_master_outage(call, what, give_up=None):
                 if give_up is None:
                     raise
                 return give_up(e)
+            if (
+                reconnect is not None
+                and now - unreachable_since >= _RECONNECT_AFTER_SECONDS
+                and now - last_reconnect >= _RECONNECT_AFTER_SECONDS
+            ):
+                last_reconnect = now
+                if reconnect():
+                    logger.info(
+                        "Master accepting again; rebuilt the channel "
+                        "(outage %.0fs, during %s)",
+                        now - unreachable_since,
+                        what,
+                    )
             time.sleep(_WAIT_SLEEP_SECONDS * 2)
 
 
@@ -87,6 +111,17 @@ class TaskDataService:
         )
         self._leased = collections.deque()
         self._pending_reports = []
+        # task_id -> lease token from the dispatched Task proto, echoed
+        # with the result so a report that straddles a master restart
+        # (delivered to the old master, retried against the new one)
+        # counts exactly once. 0 = legacy master without tokens.
+        self._lease_tokens = {}
+
+    def _remember_lease(self, task):
+        token = getattr(task, "lease_token", 0)
+        if token:
+            self._lease_tokens[task.task_id] = token
+        return task
 
     def get_task(self, task_type=pb.TRAINING, wait=True):
         """Next task from the master; blocks through WAIT states (queue
@@ -102,10 +137,11 @@ class TaskDataService:
             return self._get_task_batched(wait)
         while True:
             task = _ride_master_outage(
-                lambda: self._mc.get_task(task_type), "get_task"
+                lambda: self._mc.get_task(task_type), "get_task",
+                reconnect=getattr(self._mc, "reconnect", None),
             )
             if task.task_id >= 0:
-                return task
+                return self._remember_lease(task)
             if task.type == pb.WAIT and wait:
                 time.sleep(_WAIT_SLEEP_SECONDS)
                 continue
@@ -123,6 +159,7 @@ class TaskDataService:
                 res = _ride_master_outage(
                     lambda: self._mc.get_task_batch(self._lease_batch),
                     "get_task_batch",
+                    reconnect=getattr(self._mc, "reconnect", None),
                 )
             except grpc.RpcError as e:
                 code = e.code() if hasattr(e, "code") else None
@@ -137,7 +174,9 @@ class TaskDataService:
                     return self._get_task(pb.TRAINING, wait)
                 raise
             if res.tasks:
-                self._leased.extend(res.tasks)
+                self._leased.extend(
+                    self._remember_lease(t) for t in res.tasks
+                )
                 continue
             if res.finished:
                 return None
@@ -150,7 +189,7 @@ class TaskDataService:
         """Non-blocking eval-task poll for interleaving evaluation into the
         training loop."""
         task = self._mc.get_task(pb.EVALUATION)
-        return task if task.task_id >= 0 else None
+        return self._remember_lease(task) if task.task_id >= 0 else None
 
     def read_batches(self, task, batch_size):
         """Yield lists of raw records for the task, batch_size at a time
@@ -204,9 +243,10 @@ class TaskDataService:
         as one batched RPC (at buffer capacity or before the next lease
         fetch); failures flush immediately so the master's retry ladder
         starts without waiting out the buffer."""
+        lease_token = self._lease_tokens.pop(task_id, 0)
         if self._lease_batch > 1:
             self._pending_reports.append(
-                (task_id, err_message, exec_counters)
+                (task_id, err_message, exec_counters, lease_token)
             )
             if err_message or (
                 len(self._pending_reports) >= self._lease_batch
@@ -225,10 +265,12 @@ class TaskDataService:
 
         _ride_master_outage(
             lambda: self._mc.report_task_result(
-                task_id, err_message, exec_counters
+                task_id, err_message, exec_counters,
+                lease_token=lease_token,
             ),
             "report_task_result",
             give_up=dropped,
+            reconnect=getattr(self._mc, "reconnect", None),
         )
 
     def flush_reports(self):
@@ -252,6 +294,7 @@ class TaskDataService:
             lambda: self._mc.report_task_results(reports),
             "report_task_results",
             give_up=dropped,
+            reconnect=getattr(self._mc, "reconnect", None),
         )
 
     @property

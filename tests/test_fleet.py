@@ -680,3 +680,72 @@ class TestFleetPolicy:
         assert stats["policy"]["blacklisted"] == []
         assert stats["policy"]["ticks"] > 0  # the engine did run
         assert stats["counts"]["rpc_errors"] == 0
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestFleetMasterRestart:
+    def test_200_pods_master_restart_under_churn(self, tmp_path):
+        """SIGKILL-semantics master restart at 200 pods with churn
+        running throughout: the successor replays the journal, comes back
+        with a bumped incarnation, dispatch throughput RECOVERS (pods
+        keep ticking against the re-pointed stub), and the journal shows
+        zero double-counted tasks — every `done` op retired a distinct
+        task id, across both incarnations."""
+        from elasticdl_tpu.fleet.harness import (
+            FleetHarness,
+            churn_schedule,
+        )
+        from elasticdl_tpu.master.journal import Journal
+
+        n = 200
+        journal_dir = str(tmp_path / "journal")
+        harness = FleetHarness(
+            n_workers=n - 10,
+            n_ps=10,
+            mode="push",
+            tick_interval=0.25,
+            push_interval=0.5,
+            aggregator_interval=0.5,
+            schedule=churn_schedule(n, kills=4, stragglers=4, seed=5),
+            seed=5,
+            journal_dir=journal_dir,
+            master_snapshot_every=256,
+        )
+        try:
+            harness.start()
+            harness.run(5.0)
+            before = harness.stats()["counts"]
+            assert before["reported"] > 0  # healthy baseline
+            harness.restart_master()
+            assert harness.master.master_incarnation >= 2
+            harness.run(6.0)
+            stats = harness.stats()
+        finally:
+            harness.stop()
+        counts = stats["counts"]
+        assert counts["master_restarts"] == 1
+        # Throughput recovered: dispatch kept flowing AFTER the restart,
+        # at a rate far above "wedged" (pods re-lease against the
+        # replayed queue without relaunching).
+        resumed = counts["reported"] - before["reported"]
+        assert resumed / 6.0 > 50, (before["reported"], counts["reported"])
+        assert counts["dispatched"] > before["dispatched"]
+        # Churn kept running across the restart and was survived.
+        assert counts["kills"] >= 4
+        # Exactly-once across the crash: no done op ever retired the
+        # same task id twice — not within the surviving WAL, and not a
+        # task the snapshot had already retired.
+        snapshot, ops = Journal(journal_dir).load()
+        done_ids = [op["task_id"] for op in ops if op["op"] == "done"]
+        assert len(done_ids) == len(set(done_ids)), "double-counted task"
+        snap_done = set((snapshot or {}).get("done_ids", []))
+        assert not snap_done & set(done_ids), "re-retired a done task"
+        # Both incarnations journaled themselves.
+        incarnations = [
+            op["value"] for op in ops if op["op"] == "incarnation"
+        ]
+        peak = max(
+            [int((snapshot or {}).get("incarnation", 0))] + incarnations
+        )
+        assert peak >= 2

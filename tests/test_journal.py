@@ -1,0 +1,333 @@
+"""Unit surface of the master write-ahead journal (jax-free, fast).
+
+The central claim the master-kill drills rest on, checked here in
+milliseconds instead of processes: REPLAYING the journal reproduces the
+live state machine exactly — `replay(journal(ops)) == live_state(ops)` —
+under randomized op interleavings, mid-sequence compactions, torn tails,
+and crash-mid-snapshot litter.
+"""
+
+import json
+import os
+import random
+import struct
+import zlib
+
+import pytest
+
+from elasticdl_tpu.master import journal as j
+from elasticdl_tpu.master.journal import (
+    Journal,
+    JournalCorruptError,
+    MasterJournal,
+    empty_state,
+    read_frames,
+    replay,
+)
+from elasticdl_tpu.master.policy import WorldHintBoard
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+
+def _dispatcher(**kw):
+    defaults = dict(
+        training_shards={"f1": (0, 90), "f2": (0, 60)},
+        records_per_task=30,
+        num_epochs=2,
+        shuffle=False,
+    )
+    defaults.update(kw)
+    return TaskDispatcher(**defaults)
+
+
+def _journaled_dispatcher(tmp_path, snapshot_every=0, **kw):
+    mj = MasterJournal(
+        str(tmp_path / "journal"), snapshot_every=snapshot_every,
+        durable=False,
+    )
+    d = _dispatcher(**kw)
+    d.attach_journal(mj)
+    mj.add_state_provider(d.export_state)
+    # The documented protocol: snapshot right after attach, so the WAL
+    # only ever holds post-start ops.
+    mj.compact()
+    return d, mj
+
+
+def _reload(tmp_path):
+    mj2 = MasterJournal(str(tmp_path / "journal"), durable=False)
+    state = mj2.load()
+    mj2.close()
+    d2 = _dispatcher()
+    d2.restore_state(state)
+    return d2, state
+
+
+# ---------- the property: replay == live ----------
+
+
+@pytest.mark.parametrize("seed", [1, 7, 20260807])
+@pytest.mark.parametrize("snapshot_every", [0, 7])
+def test_replay_reproduces_live_state(tmp_path, seed, snapshot_every):
+    """Drive a LIVE journaled dispatcher through a randomized schedule of
+    leases, reports (including duplicates and stale-token retries),
+    failures, recoveries, and blacklists — with compaction racing along
+    when snapshot_every is small — then rebuild a dispatcher purely from
+    the journal. Their exported states must be identical."""
+    rng = random.Random(seed)
+    d, mj = _journaled_dispatcher(tmp_path, snapshot_every=snapshot_every)
+    outstanding = {}  # task_id -> lease token
+    for _ in range(120):
+        roll = rng.random()
+        if roll < 0.45:
+            worker = rng.randrange(3)
+            tid, task = d.get(worker)
+            if task is not None:
+                outstanding[tid] = d.lease_token(tid)
+        elif roll < 0.75 and outstanding:
+            tid = rng.choice(sorted(outstanding))
+            token = outstanding.pop(tid)
+            d.report(tid, True, lease_token=token)
+            if rng.random() < 0.2:
+                # A worker retrying its report across a blip: the
+                # duplicate must be ack-discarded, not double-counted.
+                d.report(tid, True, lease_token=token)
+        elif roll < 0.85 and outstanding:
+            tid = rng.choice(sorted(outstanding))
+            outstanding.pop(tid)
+            d.report(tid, False, err_message="injected", lease_token=0)
+        elif roll < 0.92:
+            worker = rng.randrange(3)
+            d.recover_tasks(worker)
+            outstanding.clear()
+        elif roll < 0.96:
+            d.blacklist_worker(rng.randrange(3), 300.0, reason="slow")
+        else:
+            d.unblacklist_worker(rng.randrange(3))
+        if snapshot_every and rng.random() < 0.1:
+            mj.maybe_compact()
+    live = d.export_state()
+    mj.close()
+    d2, _ = _reload(tmp_path)
+    assert d2.export_state() == live
+
+
+def test_replay_counts_records_exactly_once(tmp_path):
+    """Exactly-once accounting across a restart: every successful report
+    is journaled before the ack, so the replayed records_done equals the
+    plan even when reports were retried."""
+    d, mj = _journaled_dispatcher(tmp_path)
+    while True:
+        tid, task = d.get(0)
+        if task is None:
+            break
+        token = d.lease_token(tid)
+        d.report(tid, True, lease_token=token)
+        d.report(tid, True, lease_token=token)  # duplicate: discarded
+    live = d.export_state()
+    assert live["records_done"] == (90 + 60) * 2  # both epochs, once
+    mj.close()
+    d2, state = _reload(tmp_path)
+    assert d2.export_state()["records_done"] == live["records_done"]
+    assert state["records_done"] == live["records_done"]
+
+
+# ---------- framing: torn tails silent, corruption loud ----------
+
+
+def _wal_path(tmp_path):
+    return os.path.join(str(tmp_path / "journal"), j.WAL_NAME)
+
+
+def _write_ops(tmp_path, ops):
+    jr = Journal(str(tmp_path / "journal"), durable=False)
+    for op in ops:
+        jr.append(op)
+    jr.close()
+
+
+def test_torn_tail_dropped_silently(tmp_path):
+    """A crash mid-append leaves a truncated final frame; replay must
+    keep the valid prefix and never raise — that is the exact crash the
+    journal exists to survive."""
+    ops = [{"op": "incarnation", "value": i} for i in range(1, 6)]
+    _write_ops(tmp_path, ops)
+    path = _wal_path(tmp_path)
+    size = os.path.getsize(path)
+    for cut in (1, 5, 11):  # inside header / inside payload
+        with open(path, "rb") as f:
+            data = f.read()
+        with open(path, "wb") as f:
+            f.write(data[: size - cut])
+        snapshot, loaded = Journal(str(tmp_path / "journal")).load()
+        assert [op["value"] for op in loaded] == [1, 2, 3, 4]
+        with open(path, "wb") as f:  # restore for the next cut
+            f.write(data)
+
+
+def test_crc_corruption_mid_file_is_loud(tmp_path):
+    """A bit-flip in a COMPLETE mid-file record is real corruption:
+    silently skipping it would desync replay from the acked RPC history,
+    so load must raise JournalCorruptError."""
+    _write_ops(tmp_path, [{"op": "incarnation", "value": i} for i in range(3)])
+    path = _wal_path(tmp_path)
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    # Flip one payload byte of the SECOND frame (past its 8-byte header).
+    first_len = struct.unpack_from("<I", data, 0)[0]
+    second_payload_at = 8 + first_len + 8
+    data[second_payload_at] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+    with pytest.raises(JournalCorruptError):
+        Journal(str(tmp_path / "journal")).load()
+
+
+def test_read_frames_roundtrip_empty_and_exact():
+    assert read_frames(b"") == []
+    payload = json.dumps({"op": "x"}).encode()
+    frame = struct.pack(
+        "<II", len(payload), zlib.crc32(payload) & 0xFFFFFFFF
+    ) + payload
+    assert read_frames(frame) == [{"op": "x"}]
+    assert read_frames(frame + frame[: 3]) == [{"op": "x"}]  # torn header
+
+
+# ---------- snapshots: atomicity and litter ----------
+
+
+def test_crash_mid_snapshot_keeps_previous_authoritative(tmp_path):
+    """A crash between writing snapshot.json.tmp and os.replace leaves
+    .tmp litter; load must ignore it and serve the previous snapshot +
+    full WAL."""
+    jdir = str(tmp_path / "journal")
+    jr = Journal(jdir, durable=False)
+    jr.snapshot({"records_done": 7})
+    jr.append({"op": "incarnation", "value": 2})
+    # Simulate the torn successor: a half-written .tmp that never
+    # published.
+    with open(os.path.join(jdir, j.SNAPSHOT_NAME + ".tmp"), "w") as f:
+        f.write('{"records_done": 999999, "trunc')
+    jr.close()
+    snapshot, ops = Journal(jdir).load()
+    assert snapshot == {"records_done": 7}
+    assert [op["op"] for op in ops] == ["incarnation"]
+    state = replay(snapshot, ops)
+    assert state["records_done"] == 7
+    assert state["incarnation"] == 2
+
+
+def test_stale_wal_over_fresh_snapshot_is_idempotent():
+    """The crash window between publishing a snapshot and truncating the
+    WAL replays already-folded ops; `done` for a retired task must not
+    double-count."""
+    task = ["f1", 0, 30, 0, -1, 0]
+    ops = [
+        {"op": "tasks_created", "epoch": 1, "tasks": [task]},
+        {"op": "lease", "task_id": 0, "worker": 0, "task": task, "token": 1},
+        {"op": "done", "task_id": 0, "records": 30},
+    ]
+    state = replay(None, ops)
+    assert state["records_done"] == 30
+    # Snapshot state already consumed task 0; the stale WAL replays the
+    # same done on top of it.
+    again = replay(state, [{"op": "done", "task_id": 0, "records": 30}])
+    assert again["records_done"] == 30
+
+
+def test_unknown_op_kind_is_ignored():
+    """Forward compatibility: a newer master's op vocabulary must not
+    brick replay."""
+    state = replay(None, [{"op": "from_the_future", "x": 1}])
+    assert state == empty_state()
+
+
+# ---------- compaction protocol ----------
+
+
+def test_record_never_compacts_inline(tmp_path):
+    """Regression: record() is called under the callers' own locks, so
+    it must NEVER call back into the state providers — a provider
+    compaction from inside record() self-deadlocks the master. Due-ness
+    accrues; only maybe_compact() (the maintenance tick) compacts."""
+    calls = []
+    mj = MasterJournal(str(tmp_path / "j"), snapshot_every=3, durable=False)
+    mj.add_state_provider(lambda: calls.append(1) or {"records_done": 0})
+    for i in range(10):
+        mj.record({"op": "incarnation", "value": i})
+    assert calls == []  # providers untouched by record()
+    assert mj.compaction_due()
+    assert mj.maybe_compact()
+    assert calls == [1]
+    assert not mj.compaction_due()
+    assert not mj.maybe_compact()  # below threshold again
+    mj.close()
+
+
+def test_compaction_truncates_wal_and_replay_matches(tmp_path):
+    mj = MasterJournal(str(tmp_path / "j"), snapshot_every=0, durable=False)
+    mj.add_state_provider(lambda: {"records_done": 123, "incarnation": 2})
+    mj.record({"op": "done", "task_id": 1, "records": 100})
+    mj.compact()
+    mj.record({"op": "done", "task_id": 2, "records": 23})
+    mj.close()
+    mj2 = MasterJournal(str(tmp_path / "j"), durable=False)
+    state = mj2.load()
+    mj2.close()
+    # Snapshot holds the provider's word; only the post-compaction op
+    # replays on top (task 1's op was folded and truncated away).
+    assert state["records_done"] == 123 + 23
+    assert state["incarnation"] == 2
+
+
+def test_failing_provider_preserves_wal(tmp_path):
+    """A bad provider must not trade a valid WAL for a broken snapshot."""
+    mj = MasterJournal(str(tmp_path / "j"), snapshot_every=0, durable=False)
+    mj.add_state_provider(lambda: 1 / 0)
+    mj.record({"op": "done", "task_id": 1, "records": 100})
+    mj.compact()  # swallowed, logged, no snapshot taken
+    mj.close()
+    mj2 = MasterJournal(str(tmp_path / "j"), durable=False)
+    assert mj2.load()["records_done"] == 100
+    mj2.close()
+
+
+# ---------- world-hint seq across incarnations ----------
+
+
+def test_hint_seq_monotonic_across_incarnations(tmp_path):
+    """Regression for the master-kill-during-scale window: the hint is
+    journaled write-ahead, so a successor replaying the journal resumes
+    the seq — a board restarting at 0 would make every post-restart
+    announce look stale to the trainers."""
+    mj = MasterJournal(str(tmp_path / "j"), snapshot_every=0, durable=False)
+    b1 = WorldHintBoard()
+    b1.attach_journal(mj)
+    mj.add_state_provider(b1.export_state)
+    assert b1.announce(3, "grow") == 1
+    assert b1.announce(4, "grow harder") == 2
+    # Crash here: the actuation never happened, but both hints are in
+    # the WAL.
+    mj.close()
+    mj2 = MasterJournal(str(tmp_path / "j"), durable=False)
+    state = mj2.load()
+    b2 = WorldHintBoard()
+    b2.restore_state(state)
+    cur = b2.current()
+    assert cur["hint_seq"] == 2
+    assert cur["target_world_size"] == 4
+    # The next incarnation's announces continue the series.
+    assert b2.announce(5, "post-recovery") == 3
+    mj2.close()
+
+
+def test_hint_seq_survives_compaction(tmp_path):
+    mj = MasterJournal(str(tmp_path / "j"), snapshot_every=0, durable=False)
+    b1 = WorldHintBoard()
+    b1.attach_journal(mj)
+    mj.add_state_provider(b1.export_state)
+    b1.announce(3, "grow")
+    mj.compact()  # hint now lives in the snapshot, WAL truncated
+    mj.close()
+    mj2 = MasterJournal(str(tmp_path / "j"), durable=False)
+    assert mj2.load()["hint_seq"] == 1
+    mj2.close()

@@ -1,0 +1,116 @@
+"""Master-kill drills: the control plane itself is the failure domain.
+
+A chaos rule SIGKILLs the master process mid-job; the drill relaunches
+`elasticdl_tpu.master.main` over the SAME journal directory and port.
+The successor must replay snapshot+WAL, re-enter with a bumped
+incarnation, re-lease the stranded in-flight tasks, and drain the job to
+EXACT records accounting — the orphaned workers ride their
+master-patience window and re-register, and a result that straddled the
+restart counts exactly once (lease tokens). docs/ROBUSTNESS.md covers
+the recovery contract.
+"""
+
+import os
+import sys
+
+import pytest
+
+import test_module
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from elastic_drill import run_drill  # noqa: E402
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+
+def _write_data(tmp_path, n=256):
+    from elasticdl_tpu.data.recordfile import RecordFileWriter
+
+    data = str(tmp_path / "linear.edlr")
+    with RecordFileWriter(data) as w:
+        for r in test_module.make_linear_records(n):
+            w.write(r)
+    return data
+
+
+def _assert_recovery_trail(result):
+    """The parts of the verdict common to every master-kill scenario."""
+    assert result["master_killed"], (
+        "the chaos kill never fired: " + str(result.get("train_returncode"))
+    )
+    assert result["completed"], result.get("relaunch_log_tail", "")[-1500:]
+    # The successor re-entered with a bumped monotonic incarnation and
+    # said so in the shared event log.
+    assert result["master_incarnation"] >= 2, result
+    rec = result["master_recovered_event"]
+    assert rec is not None, "no master_recovered event in events.jsonl"
+    assert int(rec.get("incarnation", 0)) >= 2, rec
+    # In-flight leases at the crash must leave a re-lease trail; a crash
+    # that caught every worker between tasks strands none — then an
+    # empty trail is the correct accounting.
+    assert (
+        result["lease_reissued_event"] is not None
+        or int(rec.get("leases", 0)) == 0
+    ), rec
+    assert not result["leftover_procs"], result["leftover_procs"]
+
+
+def test_master_kill_drill(tmp_path):
+    """SIGKILL the master mid-dispatch; the relaunched master must replay
+    the journal and drain the job to records_done EXACTLY equal to the
+    plan — zero lost, zero double-counted, despite orphaned workers
+    re-reporting results leased by the previous incarnation."""
+    data = _write_data(tmp_path)
+    obs_dir = str(tmp_path / "obs")
+    epochs = 40
+    result = run_drill(
+        data,
+        model_zoo=os.path.join(REPO, "tests"),
+        model_def="test_module",
+        num_workers=2,
+        num_ps=0,
+        num_epochs=epochs,
+        scenario="master-kill",
+        obs_dir=obs_dir,
+        env_overrides={"JAX_PLATFORMS": "cpu"},
+        timeout=300,
+    )
+    _assert_recovery_trail(result)
+    # Exactly-once across the restart: the journal the successor closed
+    # over must account for every planned record exactly once.
+    assert result["records_done"] == 256 * epochs, result
+
+
+def test_master_kill_during_scale_drill(tmp_path):
+    """Crash the master BETWEEN the world-hint announce and the scale
+    actuation (injection point master.scale). The hint is write-ahead:
+    the recovered hint board must resume at (or beyond) the pre-crash
+    hint_seq — a regressed seq would un-announce a world that workers
+    may already be speculatively compiling."""
+    data = _write_data(tmp_path)
+    obs_dir = str(tmp_path / "obs")
+    epochs = 200
+    result = run_drill(
+        data,
+        model_zoo=os.path.join(REPO, "tests"),
+        model_def="test_module",
+        num_workers=2,
+        num_ps=0,
+        num_epochs=epochs,
+        scenario="master-kill-during-scale",
+        obs_dir=obs_dir,
+        env_overrides={"JAX_PLATFORMS": "cpu"},
+        timeout=360,
+    )
+    _assert_recovery_trail(result)
+    # The crash fired at the scale actuation, so the announce had
+    # already happened — and survived.
+    assert result["hint_seq_at_kill"] >= 1, result
+    assert result["hint_seq_recovered"] is not None, result
+    assert result["hint_seq_recovered"] >= result["hint_seq_at_kill"], (
+        result["hint_seq_at_kill"],
+        result["hint_seq_recovered"],
+    )
+    assert result["records_done"] == 256 * epochs, result

@@ -55,6 +55,8 @@ SCENARIOS = (
     "deadline-scale",
     "preemption-wave",
     "input-starve",
+    "master-kill",
+    "master-kill-during-scale",
 )
 
 # Scenarios that close the loop through the policy engine: they need the
@@ -63,6 +65,14 @@ POLICY_SCENARIOS = (
     "straggler-recovery",
     "backup-task",
     "deadline-scale",
+)
+
+# Scenarios that SIGKILL the master itself (via the deterministic local
+# chaos kill fault) and relaunch it over the journal: they need obs_dir
+# both for the journal directory and the recovery event trail.
+MASTER_KILL_SCENARIOS = (
+    "master-kill",
+    "master-kill-during-scale",
 )
 
 
@@ -268,6 +278,53 @@ def scenario_env(scenario):
             "ELASTICDL_CHAOS": json.dumps(schedule),
             "ELASTICDL_AGGREGATOR_INTERVAL": "1.0",
         }
+    if scenario == "master-kill":
+        # Deterministic master crash: the kill fault fires at the Nth
+        # task dispatch (inject_local("master.dispatch") in the servicer,
+        # counted across get_task + get_task_batch calls). start is high
+        # enough that training provably progressed — and low enough that
+        # plenty of work remains for the relaunched master to finish.
+        schedule = {
+            "seed": 20260807,
+            "rules": [
+                {
+                    "method": "master.dispatch",
+                    "kind": "kill",
+                    "start": 40,
+                    "count": 1,
+                    "side": "client",
+                },
+            ],
+        }
+        return {"ELASTICDL_CHAOS": json.dumps(schedule)}
+    if scenario == "master-kill-during-scale":
+        # The nastier window: crash BETWEEN the world-hint announce
+        # (journaled + emitted) and the scale actuation. The recovered
+        # hint board must resume from the journaled seq, never regress.
+        # The deadline is set far below any achievable drain time so the
+        # overshoot condition holds on every policy tick once throughput
+        # data exists — a generous deadline made the scale decision (and
+        # therefore the kill) a race against fast workers.
+        env = _policy_env(
+            ELASTICDL_JOB_DEADLINE_SECONDS="5",
+            ELASTICDL_POLICY_SCALE_STEP="1",
+            ELASTICDL_POLICY_MAX_WORKERS="4",
+            ELASTICDL_POLICY_STRAGGLER_SCORE="1e9",
+            ELASTICDL_POLICY_MAX_BACKUPS="0",
+        )
+        env["ELASTICDL_CHAOS"] = json.dumps({
+            "seed": 20260807,
+            "rules": [
+                {
+                    "method": "master.scale",
+                    "kind": "kill",
+                    "start": 0,
+                    "count": 1,
+                    "side": "client",
+                },
+            ],
+        })
+        return env
     if scenario == "master-stall":
         # Shrink the control-plane deadlines below the stall length so the
         # workers' calls fail fast and RETRY through the stall (instead of
@@ -387,6 +444,11 @@ def run_drill(
             "engine's input is the master's telemetry aggregator, and "
             "the decision trail is read from events.jsonl"
         )
+    if scenario in MASTER_KILL_SCENARIOS and not obs_dir:
+        raise ValueError(
+            f"the {scenario} scenario needs --obs_dir: it hosts the "
+            "master journal and the master_recovered event trail"
+        )
     port = _free_port()
     env = dict(os.environ)
     # Full control of the children's import path — do NOT append the
@@ -400,25 +462,31 @@ def run_drill(
     env.update(env_overrides or {})
     if obs_dir and "ELASTICDL_OBS_DIR" not in (env_overrides or {}):
         env["ELASTICDL_OBS_DIR"] = obs_dir
+    if scenario in MASTER_KILL_SCENARIOS:
+        env.setdefault(
+            "ELASTICDL_MASTER_JOURNAL_DIR",
+            os.path.join(obs_dir, "journal"),
+        )
     scraper = MetricsScraper(obs_dir) if obs_dir else None
+    train_cmd = [
+        sys.executable, "-m", "elasticdl_tpu.client.main", "train",
+        "--model_zoo", model_zoo,
+        "--model_def", model_def,
+        "--training_data", data_path,
+        "--num_epochs", str(num_epochs),
+        "--records_per_task", str(records_per_task),
+        "--minibatch_size", str(minibatch_size),
+        "--num_workers", str(num_workers),
+        "--num_ps", str(num_ps),
+        "--distribution_strategy",
+        strategy
+        or ("ParameterServerStrategy" if num_ps else "Local"),
+        "--instance_backend", "local_process",
+        "--master_port", str(port),
+        *extra_args,
+    ]
     train = subprocess.Popen(
-        [
-            sys.executable, "-m", "elasticdl_tpu.client.main", "train",
-            "--model_zoo", model_zoo,
-            "--model_def", model_def,
-            "--training_data", data_path,
-            "--num_epochs", str(num_epochs),
-            "--records_per_task", str(records_per_task),
-            "--minibatch_size", str(minibatch_size),
-            "--num_workers", str(num_workers),
-            "--num_ps", str(num_ps),
-            "--distribution_strategy",
-            strategy
-            or ("ParameterServerStrategy" if num_ps else "Local"),
-            "--instance_backend", "local_process",
-            "--master_port", str(port),
-            *extra_args,
-        ],
+        train_cmd,
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
         text=True,
@@ -466,6 +534,11 @@ def run_drill(
         while True:
             s = status(deadline)
             if s is None:
+                if (
+                    scenario in MASTER_KILL_SCENARIOS
+                    and train.poll() is not None
+                ):
+                    break  # injected SIGKILL beat the first observation
                 raise RuntimeError("job never started making progress")
             if s.records_done > 0 and s.alive_workers >= num_workers:
                 break
@@ -526,6 +599,11 @@ def run_drill(
             result["wave_killed"] = chaos_process.preemption_wave(
                 num_workers, port, fraction=wave_fraction, seed=20260807
             )
+        elif scenario in MASTER_KILL_SCENARIOS:
+            s = _do_master_kill(
+                train, train_cmd, status, s, port, obs_dir, result,
+                timeout, env, scenario, chaos_process,
+            )
         # rpc-brownout: nothing to do here — the chaos schedule shipped in
         # the environment is already injecting faults.
 
@@ -544,6 +622,10 @@ def run_drill(
 
         train.wait(timeout=timeout)
         result["completed"] = train.returncode == 0
+        if scenario in MASTER_KILL_SCENARIOS:
+            # The original master is SUPPOSED to die (SIGKILL); the job's
+            # verdict is the relaunched master's.
+            result["completed"] = bool(result.get("relaunch_completed"))
         out = train.stdout.read()
         result["relaunched"] = "Relaunching worker 0" in out
         result["ps_relaunched"] = "Relaunching ps 0" in out
@@ -561,6 +643,14 @@ def run_drill(
         if s is not None:
             result["records_done"] = int(s.records_done)
             result["tasks_abandoned"] = int(s.tasks_abandoned)
+        if (
+            scenario in MASTER_KILL_SCENARIOS
+            and result.get("records_done_journal") is not None
+        ):
+            # The journal the successor closed over is authoritative:
+            # the drill's last status observation can be stale when the
+            # recovered master drains and exits between polls.
+            result["records_done"] = result["records_done_journal"]
         if scraper is not None:
             result["metrics"] = scraper.totals()
         return result
@@ -945,6 +1035,187 @@ def _do_deadline_scale(status, s, obs_dir, result, timeout):
     return s
 
 
+def _do_master_kill(train, train_cmd, status, s, port, obs_dir, result,
+                    timeout, env, scenario, chaos_process):
+    """The survivable-control-plane drill: the chaos kill fault SIGKILLs
+    the master (the `edl train` process, local backend) mid-job; the
+    drill relaunches `elasticdl_tpu.master.main` over the SAME journal
+    dir and port (orphaned workers ride their master-patience window and
+    re-register with the bumped incarnation), and the recovered job must
+    drain to completion with exactly-once records accounting (checked by
+    the caller via --expect_records)."""
+    import grpc
+
+    from elasticdl_tpu.common import rpc
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    # 1. Wait for the injected SIGKILL to land.
+    deadline = time.time() + timeout
+    while train.poll() is None and time.time() < deadline:
+        s2 = status(time.time() + 2)
+        if s2 is not None:
+            s = s2
+            if s.finished or s.job_failed:
+                break
+        time.sleep(0.1)
+    result["master_killed"] = train.poll() is not None
+    result["train_returncode"] = train.poll()
+    if s is not None:
+        result["records_at_kill"] = int(s.records_done)
+    pre_hint = _find_event(obs_dir, "world_hint")
+    # The hint's own sequence number lives under hint_seq — the bare
+    # `seq` on the record is the event-log envelope counter (file
+    # order), a different series entirely.
+    result["hint_seq_at_kill"] = (
+        int(pre_hint.get("hint_seq", 0)) if pre_hint else 0
+    )
+    if train.poll() is None:
+        return s  # the kill never fired; the ok-gate fails on master_killed
+
+    # 2. Relaunch the master over the same journal: master.main takes the
+    #    same argv the client forwarded, with --instance_backend none —
+    #    the original workers are alive, riding the patience window
+    #    toward the fixed --master_port; spawning a second cohort would
+    #    double the world. Chaos is stripped so the successor does not
+    #    re-kill itself at the next matching dispatch.
+    master_args = list(train_cmd[train_cmd.index("train") + 1:])
+    backend_at = master_args.index("--instance_backend")
+    master_args[backend_at + 1] = "none"
+    relaunch_env = {
+        k: v for k, v in env.items() if k != "ELASTICDL_CHAOS"
+    }
+    t_relaunch = time.time()
+    master2 = subprocess.Popen(
+        [sys.executable, "-m", "elasticdl_tpu.master.main"]
+        + master_args,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=relaunch_env,
+        cwd=REPO,
+        start_new_session=True,
+    )
+    result["relaunched_master"] = master2.pid
+    try:
+        rpc.wait_channel_ready(
+            f"127.0.0.1:{port}",
+            timeout,
+            abort_check=lambda: master2.poll() is not None,
+        )
+        # The drill's own per-peer circuit breaker tripped during the
+        # dead window; its 5s half-open cadence can eat the successor's
+        # whole serving window on a fast recovery. The port provably
+        # accepts again — reset the breakers and observe immediately.
+        rpc.reload_config()
+        stub2 = rpc.Stub(
+            rpc.build_channel(f"127.0.0.1:{port}", ready_timeout=0),
+            rpc.MASTER_SERVICE,
+        )
+
+        def status2(poll_deadline):
+            while time.time() < poll_deadline:
+                try:
+                    return stub2.get_job_status(pb.GetJobStatusRequest())
+                except grpc.RpcError:
+                    if master2.poll() is not None:
+                        return None
+                    time.sleep(0.2)
+            return None
+
+        s2 = status2(time.time() + 30)
+        if s2 is not None:
+            s = s2
+            result["master_incarnation"] = int(
+                getattr(s2, "master_incarnation", 0)
+            )
+            result["records_after_replay"] = int(s2.records_done)
+        if scenario == "master-kill-during-scale":
+            # hint_seq monotonicity across incarnations: the recovered
+            # board must resume at (or beyond) the pre-crash seq.
+            try:
+                hint = stub2.get_world_hint(
+                    pb.GetWorldHintRequest(worker_id=0)
+                )
+                result["hint_seq_recovered"] = int(hint.hint_seq)
+            except grpc.RpcError:
+                result["hint_seq_recovered"] = None
+
+        # 3. Drain the recovered job to completion.
+        drain_deadline = time.time() + timeout
+        while time.time() < drain_deadline:
+            s2 = status2(time.time() + 10)
+            if s2 is None:
+                break
+            s = s2
+            if s2.finished or s2.job_failed:
+                break
+            time.sleep(0.3)
+        master2.wait(timeout=timeout)
+        result["recovery_s"] = round(time.time() - t_relaunch, 3)
+        # Exit code 0 is itself the completion verdict: the master's run
+        # loop returns 0 only once the job finished without failure. A
+        # fast recovery can drain and exit between two status polls, so
+        # "the drill observed finished" is sufficient but not necessary.
+        result["relaunch_completed"] = master2.returncode == 0 or (
+            s is not None and bool(s.finished) and not s.job_failed
+        )
+        out2 = master2.stdout.read()
+        result["relaunch_log_tail"] = out2[-2000:]
+        # Authoritative records accounting comes from the journal the
+        # successor just closed over — immune to the status-poll race
+        # above and exactly what the exactly-once claim is about.
+        jdir = env.get("ELASTICDL_MASTER_JOURNAL_DIR")
+        if jdir:
+            try:
+                from elasticdl_tpu.master import journal as mjournal
+
+                snap, ops = mjournal.Journal(jdir).load()
+                jstate = mjournal.replay(snap, ops)
+                result["records_done_journal"] = int(
+                    jstate.get("records_done", 0)
+                )
+                result["incarnation_journal"] = int(
+                    jstate.get("incarnation", 0)
+                )
+                # Status-poll fallbacks, same staleness rationale.
+                if "master_incarnation" not in result:
+                    result["master_incarnation"] = result[
+                        "incarnation_journal"
+                    ]
+                if result.get("hint_seq_recovered") is None:
+                    result["hint_seq_recovered"] = (
+                        int(jstate.get("hint_seq", 0)) or None
+                    )
+            except Exception as e:  # observation plane must not fail the drill
+                result["journal_read_error"] = repr(e)
+    finally:
+        if master2.poll() is None:
+            master2.kill()
+        try:
+            os.killpg(os.getpgid(master2.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+    # The recovery event trail (events.jsonl is append-mode, so both
+    # incarnations land in one file).
+    result["master_recovered_event"] = _find_event(
+        obs_dir, "master_recovered"
+    )
+    result["lease_reissued_event"] = _find_event(
+        obs_dir, "lease_reissued"
+    )
+    # 4. The orphaned workers exit on the finished signal; reap anything
+    #    that missed it so the caller's stdout drain and zero-leftover
+    #    check don't hang on the shared pipe.
+    wait_deadline = time.time() + 20
+    while time.time() < wait_deadline:
+        if not chaos_process.find_job_pids(port):
+            break
+        time.sleep(0.5)
+    for pid, _ in chaos_process.find_job_pids(port):
+        chaos_process.deliver(pid, signal.SIGKILL)
+    return s
+
+
 def _do_worker_kill(train, stub, status, s, port, result,
                     require_victim_task, chaos_process):
     """The original drill: SIGKILL worker 0 (preemption) and measure the
@@ -1090,6 +1361,7 @@ def main():
     needs_obs = (
         args.scenario in ("straggler", "input-starve")
         or args.scenario in POLICY_SCENARIOS
+        or args.scenario in MASTER_KILL_SCENARIOS
     )
     if needs_obs and not obs_dir:
         import tempfile
@@ -1140,6 +1412,24 @@ def main():
         )
     elif args.scenario == "preemption-wave":
         ok = ok and bool(result.get("wave_killed"))
+    elif args.scenario in MASTER_KILL_SCENARIOS:
+        ok = ok and bool(result.get("master_killed"))
+        ok = ok and result.get("master_incarnation", 0) >= 2
+        rec = result.get("master_recovered_event")
+        ok = ok and rec is not None
+        # The re-lease trail exists whenever the crash stranded in-flight
+        # leases (a crash that caught both workers between tasks strands
+        # none — then an empty trail is correct).
+        ok = ok and (
+            result.get("lease_reissued_event") is not None
+            or int((rec or {}).get("leases", 0)) == 0
+        )
+        if args.scenario == "master-kill-during-scale":
+            ok = ok and result.get("hint_seq_at_kill", 0) >= 1
+            ok = ok and (
+                (result.get("hint_seq_recovered") or 0)
+                >= result.get("hint_seq_at_kill", 0)
+            )
     if args.expect_records:
         ok = ok and result.get("records_done") == args.expect_records
     return 0 if ok else 1

@@ -47,6 +47,14 @@ NEW_FIELDS = {
         ("policy_blacklisted", 22, F.TYPE_STRING, F.LABEL_REPEATED),
         ("backup_tasks_inflight", 23, F.TYPE_INT32, F.LABEL_OPTIONAL),
         ("backup_wins", 24, F.TYPE_INT64, F.LABEL_OPTIONAL),
+        # Survivable control plane (master/journal.py).
+        ("master_incarnation", 25, F.TYPE_INT64, F.LABEL_OPTIONAL),
+    ],
+    "Task": [
+        ("lease_token", 8, F.TYPE_INT64, F.LABEL_OPTIONAL),
+    ],
+    "ReportTaskResultRequest": [
+        ("lease_token", 4, F.TYPE_INT64, F.LABEL_OPTIONAL),
     ],
     "PushGradientsResponse": [
         ("apply_seconds", 3, F.TYPE_FLOAT, F.LABEL_OPTIONAL),
